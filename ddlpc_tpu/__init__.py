@@ -1,0 +1,33 @@
+"""ddlpc_tpu — TPU-native distributed segmentation training framework.
+
+A ground-up JAX/XLA re-design of the capabilities of
+``NikolayKrivosheev/Distributed-deep-learning-on-personal-computers``
+(reference: single-file PyTorch script ``Vaihingen PyTorch 2 (кластер).py``):
+synchronous data-parallel training of convolutional segmentation models with
+gradient accumulation and optional lossy (max-abs int8 / fp16) gradient
+compression.  Where the reference hand-rolls a TCP parameter-server star
+(кластер.py:105-252), this framework uses a `jax.sharding.Mesh` with XLA
+collectives over ICI/DCN; where the reference is one hostname-keyed script,
+this is a package with a config system, sharded data pipeline, checkpointing,
+metrics (incl. mIoU) and tests.
+
+Package layout
+--------------
+- ``models/``   Flax NHWC model zoo: U-Net, U-Net++, DeepLabV3+.
+- ``ops/``      Losses, metrics, the gradient quantization codec, Pallas kernels.
+- ``parallel/`` Mesh construction, shard_map train/eval steps, halo exchange.
+- ``data/``     Tile datasets (Vaihingen/Potsdam/Cityscapes-style), host sharding.
+- ``train/``    Trainer driver, checkpointing, logging/observability.
+- ``utils/``    Wire codec (C++-backed compression), misc.
+"""
+
+__version__ = "0.1.0"
+
+from ddlpc_tpu.config import (  # noqa: F401
+    CompressionConfig,
+    DataConfig,
+    ExperimentConfig,
+    ModelConfig,
+    ParallelConfig,
+    TrainConfig,
+)

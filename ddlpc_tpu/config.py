@@ -1,0 +1,174 @@
+"""Typed configuration system.
+
+The reference configures everything through module-level globals and
+hostname→ID tables edited by hand on every node (кластер.py:23-25, 223-252,
+685-687).  Here configuration is a tree of frozen dataclasses that serializes
+to/from JSON, so a run is reproducible from one artifact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Tuple
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Model-zoo selection.
+
+    ``width_divisor`` mirrors the reference's ``NN_in_model`` global channel
+    divisor (кластер.py:625,687; value 2 → half-width U-Net).
+    ``up_sample_mode`` mirrors UNet(..., up_sample_mode) (кластер.py:621).
+    """
+
+    name: str = "unet"  # any name registered in models/__init__.py
+    num_classes: int = 6  # Vaihingen has 6 classes (кластер.py:702)
+    width_divisor: int = 1
+    features: Tuple[int, ...] = (64, 128, 256, 512, 512)
+    bottleneck_features: int = 512
+    up_sample_mode: str = "conv_transpose"  # conv_transpose | bilinear
+    norm: str = "batch"  # batch | group | none
+    group_norm_groups: int = 8
+    # Deep supervision heads for U-Net++.
+    deep_supervision: bool = False
+    # DeepLabV3+ specifics.
+    output_stride: int = 16
+    aspp_rates: Tuple[int, ...] = (6, 12, 18)
+    compute_dtype: str = "bfloat16"  # dtype activations are computed in
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    """Tile-dataset pipeline.
+
+    The reference eagerly loads a directory of images + ``.npy`` masks into
+    RAM and crops 512×512 (кластер.py:660-674,737).  ``data_dir=None`` selects
+    the synthetic generator (for tests/benchmarks without the ISPRS download).
+    """
+
+    data_dir: str | None = None
+    dataset: str = "vaihingen"  # vaihingen | potsdam | cityscapes | synthetic
+    image_size: Tuple[int, int] = (512, 512)  # (H, W)
+    num_classes: int = 6
+    test_split: int = 30  # last-N split, reference behavior (кластер.py:672-673)
+    shuffle: bool = True  # reference computes a shuffle but never applies it (кластер.py:722-723)
+    synthetic_len: int = 127  # reference trains on 127 tiles (кластер.py:720)
+    seed: int = 0
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Optimization loop.
+
+    ``sync_period`` is the reference's ``frequency_sending_gradients``
+    (кластер.py:685): micro-batches whose gradients are accumulated locally
+    between synchronizations/optimizer steps.  ``micro_batch_size`` is the
+    per-replica batch of one forward/backward (reference ``batch_size=1``,
+    кластер.py:686).
+    """
+
+    epochs: int = 100
+    micro_batch_size: int = 1
+    sync_period: int = 50
+    learning_rate: float = 1e-3  # torch.optim.Adam default, as the reference uses (кластер.py:704)
+    optimizer: str = "adam"
+    weight_decay: float = 0.0
+    seed: int = 0
+    log_every_steps: int = 1
+    checkpoint_every_epochs: int = 1
+    keep_checkpoints: int = 3
+    eval_every_epochs: int = 1
+    dump_images_per_epoch: int = 5  # qualitative PNG triples (кластер.py:785-790)
+
+
+@dataclass(frozen=True)
+class ParallelConfig:
+    """Device-mesh topology.
+
+    Replaces the reference's L0–L4 socket stack (кластер.py:43-252): the data
+    axis carries gradient all-reduce (the reference's parameter-server round
+    trip), the space axis shards the spatial H dimension with halo exchange
+    (the conv analog of sequence/context parallelism).
+    ``data_axis_size=-1`` means "all remaining devices".
+    """
+
+    data_axis_size: int = -1
+    space_axis_size: int = 1
+    data_axis_name: str = "data"
+    space_axis_name: str = "space"
+    sync_batch_norm: bool = True  # reference lets BN stats drift per replica (SURVEY §3.1)
+
+
+@dataclass(frozen=True)
+class CompressionConfig:
+    """Lossy gradient codec — the reference's research contribution.
+
+    ``mode`` mirrors ``model_bytes`` ∈ {'float32','float16','int8'}
+    (кластер.py:25).  int8 uses ±``int8_levels`` integer levels
+    (round(g/max*10), кластер.py:474); float16 uses ±``fp16_levels`` integer
+    levels stored as fp16 (round(g/max*100), кластер.py:487).  Unlike the
+    reference, 'float32'/'none' is a working identity path (its fp32 branch
+    zeroes gradients, кластер.py:315,432) and max==0 cannot crash
+    (кластер.py:345-396 NameError).
+
+    ``quantize_local``: quantize each replica's gradient before the
+    all-reduce (the worker→server wire, кластер.py:450-496).
+    ``quantize_mean``: re-quantize the averaged gradient after the all-reduce
+    so every replica applies bit-identical updates (the server's re-quantized
+    broadcast + self-application trick, кластер.py:328-433).
+    """
+
+    mode: str = "none"  # none | int8 | float16
+    int8_levels: int = 10
+    fp16_levels: int = 100
+    quantize_local: bool = True
+    quantize_mean: bool = True
+
+
+@dataclass(frozen=True)
+class ExperimentConfig:
+    model: ModelConfig = field(default_factory=ModelConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    compression: CompressionConfig = field(default_factory=CompressionConfig)
+    workdir: str = "runs/default"
+
+    # ---- (de)serialization -------------------------------------------------
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_json(self, indent: int = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ExperimentConfig":
+        def build(klass, sub):
+            fields = {f.name: f for f in dataclasses.fields(klass)}
+            kwargs = {}
+            for k, v in sub.items():
+                if k not in fields:
+                    raise ValueError(f"unknown config key {klass.__name__}.{k}")
+                if isinstance(v, list):
+                    v = tuple(v)
+                kwargs[k] = v
+            return klass(**kwargs)
+
+        return cls(
+            model=build(ModelConfig, d.get("model", {})),
+            data=build(DataConfig, d.get("data", {})),
+            train=build(TrainConfig, d.get("train", {})),
+            parallel=build(ParallelConfig, d.get("parallel", {})),
+            compression=build(CompressionConfig, d.get("compression", {})),
+            workdir=d.get("workdir", "runs/default"),
+        )
+
+    @classmethod
+    def from_json(cls, s: str) -> "ExperimentConfig":
+        return cls.from_dict(json.loads(s))
+
+    def replace(self, **kwargs) -> "ExperimentConfig":
+        return dataclasses.replace(self, **kwargs)

@@ -1,0 +1,63 @@
+"""Model zoo registry.
+
+Build any registered model from a ``ModelConfig``.  The reference has exactly
+one model, U-Net (кластер.py:620-656); BASELINE.json's configs additionally
+require U-Net++ (deep supervision) and DeepLabV3+ (ASPP/atrous).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from flax import linen as nn
+
+from ddlpc_tpu.config import ModelConfig
+from ddlpc_tpu.models.unet import UNet
+
+_REGISTRY = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+@register("unet")
+def _build_unet(cfg: ModelConfig, norm_axis_name: Optional[str]) -> nn.Module:
+    import jax.numpy as jnp
+
+    return UNet(
+        num_classes=cfg.num_classes,
+        features=tuple(cfg.features),
+        bottleneck_features=cfg.bottleneck_features,
+        width_divisor=cfg.width_divisor,
+        up_sample_mode=cfg.up_sample_mode,
+        norm=cfg.norm,
+        norm_axis_name=norm_axis_name,
+        norm_groups=cfg.group_norm_groups,
+        dtype=jnp.dtype(cfg.compute_dtype),
+    )
+
+
+def build_model(cfg: ModelConfig, norm_axis_name: Optional[str] = None) -> nn.Module:
+    """norm_axis_name: mesh axis to sync BatchNorm stats over (None = local)."""
+    try:
+        builder = _REGISTRY[cfg.name]
+    except KeyError:
+        raise ValueError(
+            f"unknown model {cfg.name!r}; registered: {sorted(_REGISTRY)}"
+        ) from None
+    return builder(cfg, norm_axis_name)
+
+
+def build_model_from_experiment(ecfg) -> nn.Module:
+    """Build honoring ParallelConfig.sync_batch_norm: per-batch cross-replica
+    BN stat averaging over the data axis (the reference never re-syncs BN,
+    SURVEY §3.1)."""
+    axis = (
+        ecfg.parallel.data_axis_name if ecfg.parallel.sync_batch_norm else None
+    )
+    return build_model(ecfg.model, norm_axis_name=axis)

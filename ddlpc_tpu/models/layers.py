@@ -1,0 +1,183 @@
+"""Shared Flax building blocks for the segmentation model zoo.
+
+TPU-first conventions used throughout the zoo:
+- NHWC activations (TPU conv layout; the reference is NCHW torch, кластер.py:737).
+- bfloat16 compute / float32 params, selected per-module via ``dtype``.
+- Normalization is pluggable: 'batch' (optionally cross-replica synced via
+  ``axis_name`` — fixing the reference's silently drifting per-replica BN
+  running stats, SURVEY §3.1), 'group', or 'none'.
+
+Reference parity: DoubleConv = (Conv3×3 → BatchNorm2d → ReLU) ×2
+(кластер.py:575-588); DownBlock = DoubleConv + MaxPool2d(2) returning
+(down, skip) (кластер.py:591-600); UpBlock = ConvTranspose2d(k=2,s=2) or
+bilinear upsample, concat skip, DoubleConv (кластер.py:603-617).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+Dtype = Any
+
+
+class Norm(nn.Module):
+    """Pluggable normalization layer.
+
+    kind='batch' uses running-average BatchNorm; when ``axis_name`` is set and
+    the module runs inside a mapped axis (shard_map/pmap), batch statistics
+    are averaged across that axis — true sync-BN, unlike the reference which
+    never re-syncs running stats after the init broadcast (кластер.py:560-565).
+    """
+
+    kind: str = "batch"
+    axis_name: Optional[str] = None
+    groups: int = 8
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        if self.kind == "batch":
+            return nn.BatchNorm(
+                use_running_average=not train,
+                axis_name=self.axis_name if train else None,
+                momentum=0.9,
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+            )(x)
+        if self.kind == "group":
+            groups = min(self.groups, x.shape[-1])
+            while x.shape[-1] % groups:
+                groups -= 1
+            return nn.GroupNorm(
+                num_groups=groups, dtype=self.dtype, param_dtype=jnp.float32
+            )(x)
+        if self.kind == "none":
+            return x
+        raise ValueError(f"unknown norm kind {self.kind!r}")
+
+
+class ConvNormAct(nn.Module):
+    """3×3 same-padding conv → norm → ReLU (one half of reference DoubleConv)."""
+
+    features: int
+    kernel_size: Tuple[int, int] = (3, 3)
+    dilation: int = 1
+    norm: str = "batch"
+    norm_axis_name: Optional[str] = None
+    norm_groups: int = 8
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        x = nn.Conv(
+            self.features,
+            self.kernel_size,
+            padding="SAME",
+            kernel_dilation=(self.dilation, self.dilation),
+            use_bias=self.norm == "none",
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )(x)
+        x = Norm(
+            kind=self.norm,
+            axis_name=self.norm_axis_name,
+            groups=self.norm_groups,
+            dtype=self.dtype,
+        )(x, train)
+        return nn.relu(x)
+
+
+class DoubleConv(nn.Module):
+    """(Conv3×3 → norm → ReLU) ×2 — reference DoubleConv (кластер.py:575-588)."""
+
+    features: int
+    norm: str = "batch"
+    norm_axis_name: Optional[str] = None
+    norm_groups: int = 8
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        for _ in range(2):
+            x = ConvNormAct(
+                self.features,
+                norm=self.norm,
+                norm_axis_name=self.norm_axis_name,
+                norm_groups=self.norm_groups,
+                dtype=self.dtype,
+            )(x, train)
+        return x
+
+
+def max_pool_2x2(x: jax.Array) -> jax.Array:
+    """2×2/stride-2 max pool over NHWC (reference MaxPool2d(2), кластер.py:596)."""
+    return nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+
+
+class DownBlock(nn.Module):
+    """DoubleConv then 2× downsample; returns (downsampled, skip)
+    (reference DownBlock, кластер.py:591-600)."""
+
+    features: int
+    norm: str = "batch"
+    norm_axis_name: Optional[str] = None
+    norm_groups: int = 8
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True):
+        skip = DoubleConv(
+            self.features,
+            norm=self.norm,
+            norm_axis_name=self.norm_axis_name,
+            norm_groups=self.norm_groups,
+            dtype=self.dtype,
+        )(x, train)
+        return max_pool_2x2(skip), skip
+
+
+def upsample_2x(x: jax.Array, method: str = "bilinear") -> jax.Array:
+    """2× spatial upsample of NHWC via jax.image.resize."""
+    n, h, w, c = x.shape
+    return jax.image.resize(x, (n, 2 * h, 2 * w, c), method=method).astype(x.dtype)
+
+
+class UpBlock(nn.Module):
+    """2× upsample (transposed conv or bilinear), concat skip(s), DoubleConv
+    (reference UpBlock, кластер.py:603-617)."""
+
+    features: int
+    up_sample_mode: str = "conv_transpose"
+    norm: str = "batch"
+    norm_axis_name: Optional[str] = None
+    norm_groups: int = 8
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, skips, train: bool = True) -> jax.Array:
+        if self.up_sample_mode == "conv_transpose":
+            x = nn.ConvTranspose(
+                self.features,
+                kernel_size=(2, 2),
+                strides=(2, 2),
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+            )(x)
+        elif self.up_sample_mode == "bilinear":
+            x = upsample_2x(x, "bilinear")
+        else:
+            raise ValueError(f"unknown up_sample_mode {self.up_sample_mode!r}")
+        if not isinstance(skips, (list, tuple)):
+            skips = (skips,)
+        x = jnp.concatenate([*skips, x], axis=-1)
+        return DoubleConv(
+            self.features,
+            norm=self.norm,
+            norm_axis_name=self.norm_axis_name,
+            norm_groups=self.norm_groups,
+            dtype=self.dtype,
+        )(x, train)

@@ -1,0 +1,62 @@
+"""U-Net for semantic segmentation, NHWC / bf16, Flax.
+
+Reference parity: UNet with 5 down blocks (3→64/N→128/N→256/N→512/N→512/N),
+a DoubleConv(512/N) bottleneck, 5 up blocks and a final 1×1 conv to
+``out_classes`` logits, with ``up_sample_mode`` ∈ {conv_transpose, bilinear}
+and global width divisor N = ``NN_in_model`` (кластер.py:620-656,687).
+
+Differences (deliberate, TPU-first): NHWC layout, bf16 compute with fp32
+params, pluggable/synced normalization, arbitrary depth via ``features``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ddlpc_tpu.models.layers import DoubleConv, DownBlock, UpBlock
+
+
+class UNet(nn.Module):
+    num_classes: int = 6
+    features: Tuple[int, ...] = (64, 128, 256, 512, 512)
+    bottleneck_features: int = 512
+    width_divisor: int = 1
+    up_sample_mode: str = "conv_transpose"
+    norm: str = "batch"
+    norm_axis_name: Optional[str] = None
+    norm_groups: int = 8
+    dtype: Any = jnp.bfloat16
+
+    def _w(self, f: int) -> int:
+        return max(1, f // self.width_divisor)
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        """x: [N, H, W, C] float; returns logits [N, H, W, num_classes] float32."""
+        x = x.astype(self.dtype)
+        common = dict(
+            norm=self.norm,
+            norm_axis_name=self.norm_axis_name,
+            norm_groups=self.norm_groups,
+            dtype=self.dtype,
+        )
+        skips = []
+        for f in self.features:
+            x, skip = DownBlock(self._w(f), **common)(x, train)
+            skips.append(skip)
+        x = DoubleConv(self._w(self.bottleneck_features), **common)(x, train)
+        for f, skip in zip(reversed(self.features), reversed(skips)):
+            x = UpBlock(self._w(f), up_sample_mode=self.up_sample_mode, **common)(
+                x, skip, train
+            )
+        logits = nn.Conv(
+            self.num_classes,
+            (1, 1),
+            dtype=jnp.float32,
+            param_dtype=jnp.float32,
+        )(x.astype(jnp.float32))
+        return logits

@@ -1,0 +1,40 @@
+"""Segmentation losses.
+
+The reference uses ``nn.CrossEntropyLoss()`` over NCHW logits
+(кластер.py:703,755).  Here: mean softmax cross-entropy over NHWC logits with
+integer labels, fp32 accumulation, optional ignore_index and label smoothing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(
+    logits: jax.Array,
+    labels: jax.Array,
+    ignore_index: Optional[int] = None,
+    label_smoothing: float = 0.0,
+) -> jax.Array:
+    """Mean pixel cross-entropy.
+
+    logits: [..., C] float; labels: [...] int.  Matches torch
+    CrossEntropyLoss (mean reduction) semantics on valid pixels.
+    """
+    logits = logits.astype(jnp.float32)
+    num_classes = logits.shape[-1]
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    labels_clipped = jnp.clip(labels, 0, num_classes - 1)
+    nll = -jnp.take_along_axis(
+        log_probs, labels_clipped[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    if label_smoothing > 0.0:
+        smooth = -log_probs.mean(axis=-1)
+        nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
+    if ignore_index is None:
+        return nll.mean()
+    valid = (labels != ignore_index).astype(jnp.float32)
+    return (nll * valid).sum() / jnp.maximum(valid.sum(), 1.0)

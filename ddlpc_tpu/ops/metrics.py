@@ -1,0 +1,85 @@
+"""Segmentation metrics: pixel accuracy, confusion matrix, mIoU.
+
+The reference computes only train-set pixel accuracy
+(mean(argmax(outputs)==Y), кластер.py:775) and never mIoU; mIoU is the
+BASELINE.json north-star metric, so it is first-class here.  All functions are
+jit-friendly (static shapes, no data-dependent control flow); the confusion
+matrix is accumulated streaming across batches and reduced once at the end.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def pixel_accuracy(
+    logits: jax.Array, labels: jax.Array, ignore_index: Optional[int] = None
+) -> jax.Array:
+    """Fraction of pixels where argmax(logits) == label (кластер.py:775)."""
+    preds = jnp.argmax(logits, axis=-1)
+    correct = (preds == labels).astype(jnp.float32)
+    if ignore_index is None:
+        return correct.mean()
+    valid = (labels != ignore_index).astype(jnp.float32)
+    return (correct * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+
+
+def confusion_matrix(
+    preds: jax.Array,
+    labels: jax.Array,
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+) -> jax.Array:
+    """[C, C] float32 counts, rows = true class, cols = predicted class.
+
+    Implemented with a flat scatter-add so XLA lowers it to one
+    segment-sum — no Python loops over classes.
+    """
+    preds = preds.reshape(-1).astype(jnp.int32)
+    labels = labels.reshape(-1).astype(jnp.int32)
+    valid = (labels >= 0) & (labels < num_classes)
+    if ignore_index is not None:
+        valid &= labels != ignore_index
+    idx = jnp.where(valid, labels * num_classes + jnp.clip(preds, 0, num_classes - 1), 0)
+    weights = valid.astype(jnp.float32)
+    flat = jnp.zeros(num_classes * num_classes, jnp.float32).at[idx].add(weights)
+    return flat.reshape(num_classes, num_classes)
+
+
+def confusion_from_logits(
+    logits: jax.Array,
+    labels: jax.Array,
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+) -> jax.Array:
+    return confusion_matrix(
+        jnp.argmax(logits, axis=-1), labels, num_classes, ignore_index
+    )
+
+
+def iou_per_class(cm: jax.Array) -> jax.Array:
+    """Per-class IoU from a confusion matrix; NaN-free (absent classes → 0)."""
+    tp = jnp.diag(cm)
+    fp = cm.sum(axis=0) - tp
+    fn = cm.sum(axis=1) - tp
+    denom = tp + fp + fn
+    return jnp.where(denom > 0, tp / jnp.maximum(denom, 1.0), 0.0)
+
+
+def mean_iou(cm: jax.Array, present_only: bool = True) -> jax.Array:
+    """mIoU.  present_only averages over classes that occur in labels or preds."""
+    ious = iou_per_class(cm)
+    if not present_only:
+        return ious.mean()
+    tp = jnp.diag(cm)
+    present = (cm.sum(axis=0) + cm.sum(axis=1)) > 0
+    return jnp.where(
+        present.sum() > 0, (ious * present).sum() / jnp.maximum(present.sum(), 1), 0.0
+    )
+
+
+def accuracy_from_confusion(cm: jax.Array) -> jax.Array:
+    return jnp.diag(cm).sum() / jnp.maximum(cm.sum(), 1.0)

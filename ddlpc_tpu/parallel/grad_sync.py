@@ -1,0 +1,52 @@
+"""Synchronous gradient synchronization with optional lossy compression.
+
+This is the TPU-native replacement for the reference's whole L4 protocol
+(кластер.py:255-557): workers quantize accumulated grads and send to the
+server; the server averages, re-quantizes the average, broadcasts; everyone
+(server included, via its self-application block кластер.py:402-433) steps on
+the *same* dequantized gradient.
+
+Semantics preserved:
+- optional per-replica quantization before the reduce (the worker wire);
+- exact mean across all replicas — fixing the reference's "crooked averaging
+  … (fix!)" loop that over-divides earlier contributions and divides by the
+  worker count instead of the replica count (кластер.py:268-321, SURVEY §2.8d);
+- optional re-quantization of the mean, so every replica applies a
+  bit-identical update (SPMD + deterministic psum already guarantees
+  identical values; re-quantization reproduces the reference's *information
+  loss*, not its mechanism).
+
+Runs inside shard_map over the ``data`` mesh axis: `lax.pmean` lowers to one
+fused XLA all-reduce over ICI/DCN instead of N sequential pickled TCP
+round-trips.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax import lax
+
+from ddlpc_tpu.config import CompressionConfig
+from ddlpc_tpu.ops.quantize import fake_quantize
+
+PyTree = Any
+
+
+def sync_gradients(
+    grads: PyTree, axis_name: str, compression: CompressionConfig
+) -> PyTree:
+    """All-reduce-mean local gradients across ``axis_name``.
+
+    Call inside shard_map/pmap.  With compression.mode='none' this is a plain
+    pmean; otherwise the codec's information loss is injected at the same
+    points the reference loses it (client send: quantize_local; server
+    rebroadcast: quantize_mean).
+    """
+    if compression.quantize_local:
+        grads = fake_quantize(grads, compression)
+    grads = lax.pmean(grads, axis_name)
+    if compression.quantize_mean:
+        grads = fake_quantize(grads, compression)
+    return grads

@@ -1,0 +1,216 @@
+"""SPMD train/eval steps: shard_map over the device mesh.
+
+This module is the TPU-native equivalent of the reference's two training
+drivers (server кластер.py:690-790, worker :792-895) collapsed into one SPMD
+program:
+
+- micro-batch gradient accumulation over ``sync_period`` steps is a
+  ``lax.scan`` (reference: Python loop + loss.backward() accumulating into
+  param.grad, кластер.py:750-759);
+- gradient synchronization is one fused all-reduce inside the compiled step
+  (reference: pickle → mgzip → TCP star round trip, кластер.py:255-557) with
+  the optional lossy codec applied at the same points (see grad_sync.py);
+- the optimizer step runs identically on every replica on bit-identical
+  gradients (reference guarantees this by re-broadcasting the quantized
+  average and self-applying it, кластер.py:402-438).
+
+Everything is a pure function of (state, batch); the whole step —
+A micro-batches of forward/backward, the all-reduce, the codec, the Adam
+update — compiles to a single XLA executable with no host round trips.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from flax import struct
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddlpc_tpu.config import CompressionConfig, ExperimentConfig
+from ddlpc_tpu.ops.losses import softmax_cross_entropy
+from ddlpc_tpu.ops.metrics import confusion_from_logits, pixel_accuracy
+from ddlpc_tpu.parallel.grad_sync import sync_gradients
+
+PyTree = Any
+
+
+class TrainState(struct.PyTreeNode):
+    """Replicated training state.
+
+    The reference distributes this by pickling the live ``[network,
+    optimizer, criterion]`` CUDA object graph over TCP at startup
+    (кластер.py:560-565); here it is a pytree that the mesh keeps replicated.
+    """
+
+    step: jax.Array
+    params: PyTree
+    batch_stats: PyTree
+    opt_state: PyTree
+
+
+def create_train_state(
+    model: nn.Module,
+    tx: optax.GradientTransformation,
+    rng: jax.Array,
+    input_shape: Tuple[int, ...],
+) -> TrainState:
+    """Initialize parameters/optimizer on host. input_shape: [N, H, W, C]."""
+    variables = model.init(rng, jnp.zeros(input_shape, jnp.float32), train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=tx.init(params),
+    )
+
+
+def _loss_and_metrics(
+    model: nn.Module,
+    params: PyTree,
+    batch_stats: PyTree,
+    images: jax.Array,
+    labels: jax.Array,
+    train: bool,
+):
+    variables = {"params": params, "batch_stats": batch_stats}
+    if train:
+        logits, updates = model.apply(
+            variables, images, train=True, mutable=["batch_stats"]
+        )
+        new_stats = updates["batch_stats"]
+    else:
+        logits = model.apply(variables, images, train=False)
+        new_stats = batch_stats
+    loss = softmax_cross_entropy(logits, labels)
+    acc = pixel_accuracy(logits, labels)
+    return loss, (new_stats, acc)
+
+
+def make_train_step(
+    model: nn.Module,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    compression: CompressionConfig,
+    data_axis: str = "data",
+    donate_state: bool = True,
+) -> Callable[[TrainState, jax.Array, jax.Array], Tuple[TrainState, dict]]:
+    """Build the jitted SPMD train step.
+
+    Inputs per call:
+      images [A, B, H, W, C], labels [A, B, H, W] — A = sync_period
+    (micro-batches accumulated between optimizer steps, reference
+    ``frequency_sending_gradients`` кластер.py:685), B = *global* micro-batch,
+    sharded over the data axis.
+    Returns (new_state, metrics) with metrics averaged over A and the mesh.
+    """
+
+    def shard_body(state: TrainState, images: jax.Array, labels: jax.Array):
+        # Inside shard_map: images [A, B_local, H, W, C].
+        def micro(carry, xy):
+            grads_acc, stats = carry
+            x, y = xy
+            (loss, (stats, acc)), grads = jax.value_and_grad(
+                lambda p: _loss_and_metrics(model, p, stats, x, y, train=True),
+                has_aux=True,
+            )(state.params)
+            grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+            return (grads_acc, stats), (loss, acc)
+
+        zeros = jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), state.params)
+        (grads, batch_stats), (losses, accs) = lax.scan(
+            micro, (zeros, state.batch_stats), (images, labels)
+        )
+        num_accum = images.shape[0]
+        grads = jax.tree.map(lambda g: g / num_accum, grads)
+        # Keep BatchNorm running stats replica-identical at every sync point:
+        # with per-batch sync-BN (norm_axis_name set) this pmean is a no-op;
+        # without it, it averages the per-replica running stats — either way
+        # the returned state is genuinely replicated, unlike the reference,
+        # which never re-syncs BN stats after init (SURVEY §3.1).
+        batch_stats = jax.tree.map(
+            lambda x: lax.pmean(x, data_axis), batch_stats
+        )
+        # The one collective of the step — replaces reference L0–L4.
+        grads = sync_gradients(grads, data_axis, compression)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "loss": lax.pmean(losses.mean(), data_axis),
+            "pixel_acc": lax.pmean(accs.mean(), data_axis),
+            "grad_norm": optax.global_norm(grads),
+        }
+        new_state = TrainState(
+            step=state.step + 1,
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=opt_state,
+        )
+        return new_state, metrics
+
+    state_spec = P()  # replicated
+    sharded = jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(state_spec, P(None, data_axis), P(None, data_axis)),
+        out_specs=(state_spec, state_spec),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate_state else ())
+
+
+def make_eval_step(
+    model: nn.Module,
+    mesh: Mesh,
+    num_classes: int,
+    data_axis: str = "data",
+) -> Callable[[TrainState, jax.Array, jax.Array], dict]:
+    """Jitted eval step: batch [B, H, W, C] sharded over data; returns summed
+    confusion matrix [C, C] + mean loss (reference never evaluates held-out
+    data, SURVEY §3.3 — this is the north-star mIoU path)."""
+
+    def shard_body(state: TrainState, images: jax.Array, labels: jax.Array):
+        logits = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            images,
+            train=False,
+        )
+        cm = confusion_from_logits(logits, labels, num_classes)
+        loss = softmax_cross_entropy(logits, labels)
+        return {
+            "confusion": lax.psum(cm, data_axis),
+            "loss": lax.pmean(loss, data_axis),
+        }
+
+    sharded = jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(), P(data_axis), P(data_axis)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def make_predict_fn(
+    model: nn.Module,
+) -> Callable[[TrainState, jax.Array], jax.Array]:
+    """Single-device jitted inference: images [N,H,W,C] → class map [N,H,W]."""
+
+    @jax.jit
+    def predict(state: TrainState, images: jax.Array) -> jax.Array:
+        logits = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            images,
+            train=False,
+        )
+        return jnp.argmax(logits, axis=-1)
+
+    return predict

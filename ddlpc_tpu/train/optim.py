@@ -1,0 +1,27 @@
+"""Optimizer construction from TrainConfig.
+
+The reference uses ``torch.optim.Adam`` at default LR on every replica
+(кластер.py:704); state reaches workers via the init-time pickle broadcast
+(кластер.py:560-565).  Here optimizer state is part of the replicated
+TrainState pytree.
+"""
+
+from __future__ import annotations
+
+import optax
+
+from ddlpc_tpu.config import TrainConfig
+
+
+def build_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    if cfg.optimizer == "adam":
+        tx = optax.adam(cfg.learning_rate)
+    elif cfg.optimizer == "adamw":
+        tx = optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay)
+    elif cfg.optimizer == "sgd":
+        tx = optax.sgd(cfg.learning_rate, momentum=0.9)
+    else:
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+    if cfg.weight_decay and cfg.optimizer == "adam":
+        tx = optax.chain(optax.add_decayed_weights(cfg.weight_decay), tx)
+    return tx

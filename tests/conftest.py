@@ -1,0 +1,16 @@
+"""Force an 8-device virtual CPU mesh before any test touches JAX.
+
+This is the standard way to test pjit/shard_map collectives without TPU
+hardware (SURVEY §4).  Must run before the first backend initialization; the
+axon sitecustomize force-sets jax_platforms, so we override the config
+directly rather than the env var.
+"""
+
+import os
+
+os.environ.setdefault("JAX_NUM_CPU_DEVICES", "8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", int(os.environ["JAX_NUM_CPU_DEVICES"]))

@@ -1,0 +1,61 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddlpc_tpu.ops.losses import softmax_cross_entropy
+from ddlpc_tpu.ops.metrics import (
+    accuracy_from_confusion,
+    confusion_matrix,
+    iou_per_class,
+    mean_iou,
+    pixel_accuracy,
+)
+
+
+def test_pixel_accuracy_matches_reference_formula():
+    # reference: mean(argmax(outputs)==Y) (кластер.py:775)
+    logits = jnp.array([[[0.1, 0.9], [0.8, 0.2]], [[0.3, 0.7], [0.6, 0.4]]])[None]
+    labels = jnp.array([[1, 0], [0, 0]])[None]
+    acc = pixel_accuracy(logits, labels)
+    assert float(acc) == 0.75
+
+
+def test_confusion_matrix_counts():
+    preds = jnp.array([0, 0, 1, 2, 2, 2])
+    labels = jnp.array([0, 1, 1, 2, 2, 0])
+    cm = np.asarray(confusion_matrix(preds, labels, 3))
+    expect = np.array([[1, 0, 1], [1, 1, 0], [0, 0, 2]], np.float32)
+    np.testing.assert_array_equal(cm, expect)
+    assert float(accuracy_from_confusion(jnp.asarray(expect))) == pytest.approx(4 / 6)
+
+
+def test_miou():
+    cm = jnp.array([[2.0, 1.0], [0.0, 3.0]])
+    ious = np.asarray(iou_per_class(cm))
+    np.testing.assert_allclose(ious, [2 / 3, 3 / 4])
+    assert float(mean_iou(cm)) == pytest.approx(np.mean([2 / 3, 3 / 4]))
+
+
+def test_miou_absent_class_excluded():
+    cm = jnp.zeros((3, 3)).at[0, 0].set(5.0).at[1, 1].set(5.0)
+    assert float(mean_iou(cm, present_only=True)) == 1.0
+
+
+def test_ignore_index():
+    logits = jnp.array([[[2.0, 0.0], [0.0, 2.0]]])  # preds 0, 1
+    labels = jnp.array([[1, 255]])
+    acc = pixel_accuracy(logits, labels, ignore_index=255)
+    assert float(acc) == 0.0
+    loss_all = softmax_cross_entropy(logits, jnp.array([[1, 1]]))
+    loss_ign = softmax_cross_entropy(logits, labels, ignore_index=255)
+    assert float(loss_ign) > float(loss_all)
+
+
+def test_cross_entropy_matches_manual():
+    logits = jnp.array([[[1.0, 2.0, 0.5]]])
+    labels = jnp.array([[2]])
+    p = np.exp([1.0, 2.0, 0.5])
+    p /= p.sum()
+    np.testing.assert_allclose(
+        float(softmax_cross_entropy(logits, labels)), -np.log(p[2]), rtol=1e-6
+    )

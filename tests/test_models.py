@@ -1,0 +1,91 @@
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ddlpc_tpu.config import ModelConfig
+from ddlpc_tpu.models import build_model
+
+
+@pytest.mark.parametrize("up_mode", ["conv_transpose", "bilinear"])
+def test_unet_shapes(up_mode):
+    cfg = ModelConfig(
+        name="unet",
+        num_classes=6,
+        features=(8, 16, 32),
+        bottleneck_features=32,
+        up_sample_mode=up_mode,
+    )
+    model = build_model(cfg)
+    x = jnp.zeros((2, 64, 64, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 64, 64, 6)
+    assert logits.dtype == jnp.float32
+
+
+def test_unet_width_divisor_halves_params():
+    # reference NN_in_model divides every channel count (кластер.py:625,687)
+    def nparams(div):
+        cfg = ModelConfig(features=(8, 16), bottleneck_features=16, width_divisor=div)
+        v = build_model(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False
+        )
+        return sum(p.size for p in jax.tree.leaves(v["params"]))
+
+    assert nparams(2) < nparams(1)
+
+
+def test_unet_batchnorm_state_updates():
+    cfg = ModelConfig(features=(8, 16), bottleneck_features=16, norm="batch")
+    model = build_model(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    _, updates = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    changed = jax.tree.map(
+        lambda a, b: bool(jnp.any(a != b)),
+        variables["batch_stats"],
+        updates["batch_stats"],
+    )
+    assert any(jax.tree.leaves(changed))
+
+
+@pytest.mark.parametrize("norm", ["group", "none"])
+def test_unet_other_norms(norm):
+    cfg = ModelConfig(features=(8,), bottleneck_features=8, norm=norm)
+    model = build_model(cfg)
+    x = jnp.zeros((1, 16, 16, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    assert "batch_stats" not in variables
+    logits = model.apply(variables, x, train=True)
+    assert logits.shape == (1, 16, 16, 6)
+
+
+def test_compute_dtype_respected():
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    cfg = ModelConfig(features=(4,), bottleneck_features=4, compute_dtype="float32")
+    model = build_model(cfg)
+    assert model.dtype == jnp.float32
+
+    class Probe(nn.Module):
+        inner: nn.Module
+
+        @nn.compact
+        def __call__(self, x):
+            return self.inner(x, train=False)
+
+    # bf16 default actually computes in bf16 (activations), fp32 in fp32
+    for dt_name, want in [("bfloat16", jnp.bfloat16), ("float32", jnp.float32)]:
+        m = build_model(ModelConfig(features=(4,), bottleneck_features=4, compute_dtype=dt_name))
+        assert m.dtype == want
+
+
+def test_build_model_from_experiment_wires_sync_bn():
+    from ddlpc_tpu.config import ExperimentConfig, ParallelConfig
+    from ddlpc_tpu.models import build_model_from_experiment
+
+    e = ExperimentConfig(model=ModelConfig(features=(4,), bottleneck_features=4))
+    assert build_model_from_experiment(e).norm_axis_name == "data"
+    e2 = e.replace(parallel=ParallelConfig(sync_batch_norm=False))
+    assert build_model_from_experiment(e2).norm_axis_name is None
