@@ -1,0 +1,16 @@
+"""Data layer: tile datasets, preprocessing, host-sharded batching.
+
+The reference loads one directory of images + ``.npy`` masks eagerly into RAM
+on *every* node and iterates the same 127 tiles in the same order on each
+replica — no sharding at all (кластер.py:660-674,732,750; SURVEY §3.1).  This
+package provides the corrected design: datasets that actually shard across
+processes and mesh replicas, with a synthetic generator for tests/benchmarks.
+"""
+
+from ddlpc_tpu.data.datasets import (  # noqa: F401
+    SyntheticTiles,
+    TileDataset,
+    build_dataset,
+    train_test_split,
+)
+from ddlpc_tpu.data.loader import ShardedLoader, make_global_array  # noqa: F401

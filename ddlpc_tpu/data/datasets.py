@@ -1,0 +1,187 @@
+"""Tile datasets: directory-of-tiles readers + synthetic generator.
+
+Reference parity (кластер.py:660-674, `load_files`): scan one directory; every
+``.npy`` file is a label mask, every other file is an image read with imageio;
+stack to numpy; the last ``test_split`` samples become the held-out split
+(which the reference computes and then never uses, SURVEY §3.3 — here it feeds
+the mIoU eval).  Preprocessing parity (кластер.py:737-742): images → float32
+/255; labels → int.  Layout difference (deliberate, TPU-first): NHWC, not the
+reference's NCHW swapaxes dance.
+
+The synthetic generator produces Vaihingen-like tiles (smooth class regions +
+class-correlated color noise) so tests and benchmarks run without the ISPRS
+download; it is shape- and dtype-identical to the disk reader.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ddlpc_tpu.config import DataConfig
+
+# Known dataset geometries (BASELINE.json configs).  H, W, channels, classes.
+DATASET_SPECS = {
+    "vaihingen": dict(image_size=(512, 512), channels=3, num_classes=6),
+    "potsdam": dict(image_size=(512, 512), channels=3, num_classes=6),
+    "cityscapes": dict(image_size=(512, 1024), channels=3, num_classes=19),
+    "synthetic": dict(image_size=(512, 512), channels=3, num_classes=6),
+}
+
+
+class TileDataset:
+    """In-RAM array-backed dataset of (image [H,W,C] float32, label [H,W] int32).
+
+    Mirrors the reference's eager load-everything approach (кластер.py:660-674)
+    — appropriate for ISPRS-scale corpora (~hundreds of tiles) — but behind an
+    interface the sharded loader can index lazily.
+    """
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray):
+        if images.ndim != 4:
+            raise ValueError(f"images must be [N,H,W,C], got {images.shape}")
+        if labels.shape != images.shape[:3]:
+            raise ValueError(
+                f"labels {labels.shape} do not match images {images.shape[:3]}"
+            )
+        self.images = np.ascontiguousarray(images, np.float32)
+        self.labels = np.ascontiguousarray(labels, np.int32)
+
+    def __len__(self) -> int:
+        return self.images.shape[0]
+
+    def __getitem__(self, idx) -> Tuple[np.ndarray, np.ndarray]:
+        return self.images[idx], self.labels[idx]
+
+    @property
+    def image_shape(self) -> Tuple[int, int, int]:
+        return tuple(self.images.shape[1:])  # type: ignore[return-value]
+
+
+def load_tile_dir(
+    path: str,
+    image_size: Optional[Tuple[int, int]] = None,
+    normalize: bool = True,
+) -> TileDataset:
+    """Read one directory of image files + ``.npy`` masks (кластер.py:660-674).
+
+    Pairing is by sorted order within each kind, exactly like the reference's
+    single-pass directory scan (it relies on interleaved naming; sorting the
+    two kinds independently is the robust version of the same contract).
+    Images are center-cropped/truncated to ``image_size`` the way the
+    reference crops ``[:512, :512]`` (кластер.py:822).
+    """
+    import imageio.v2 as imageio
+
+    img_files, npy_files = [], []
+    for name in sorted(os.listdir(path)):
+        full = os.path.join(path, name)
+        if not os.path.isfile(full):
+            continue
+        (npy_files if name.endswith(".npy") else img_files).append(full)
+    if not img_files or len(img_files) != len(npy_files):
+        raise ValueError(
+            f"{path}: need equal numbers of image and .npy mask files, "
+            f"got {len(img_files)} images / {len(npy_files)} masks"
+        )
+    # Sorted-order pairing relies on consistent naming; catch schemes whose
+    # lexicographic orders diverge (e.g. zero-padded masks vs unpadded images)
+    # before they silently mislabel every tile.
+    def stem(f: str) -> str:
+        base = os.path.basename(f)
+        base = base[: base.rindex(".")] if "." in base else base
+        for suffix in ("_mask", "_label", "_labels", "_gt"):
+            base = base.removesuffix(suffix)
+        return base
+
+    mismatched = [
+        (i, stem(a), stem(b))
+        for i, (a, b) in enumerate(zip(img_files, npy_files))
+        if stem(a) != stem(b)
+        and not stem(b).startswith(stem(a))
+        and not stem(a).startswith(stem(b))
+    ]
+    if mismatched:
+        import warnings
+
+        i, a, b = mismatched[0]
+        warnings.warn(
+            f"{path}: image/mask pairing is by sorted order and pair {i} has "
+            f"unrelated stems ({a!r} vs {b!r}) — verify file naming",
+            stacklevel=2,
+        )
+    images, labels = [], []
+    for img_f, npy_f in zip(img_files, npy_files):
+        img = np.asarray(imageio.imread(img_f))
+        lab = np.load(npy_f)
+        if image_size is not None:
+            h, w = image_size
+            img, lab = img[:h, :w], lab[:h, :w]
+        images.append(img)
+        labels.append(lab)
+    x = np.stack(images).astype(np.float32)
+    if normalize:
+        x /= 255.0  # кластер.py:737
+    if x.ndim == 3:
+        x = x[..., None]
+    return TileDataset(x, np.stack(labels).astype(np.int32))
+
+
+def train_test_split(
+    ds: TileDataset, test_split: int
+) -> Tuple[TileDataset, TileDataset]:
+    """Last-N holdout, reference behavior (кластер.py:672-673)."""
+    n = len(ds)
+    k = min(max(test_split, 0), n - 1) if n > 1 else 0
+    cut = n - k
+    return (
+        TileDataset(ds.images[:cut], ds.labels[:cut]),
+        TileDataset(ds.images[cut:], ds.labels[cut:]),
+    )
+
+
+def SyntheticTiles(
+    num_tiles: int = 127,
+    image_size: Tuple[int, int] = (512, 512),
+    channels: int = 3,
+    num_classes: int = 6,
+    seed: int = 0,
+) -> TileDataset:
+    """Vaihingen-like synthetic tiles: blocky class regions, class-tinted pixels.
+
+    Labels are piecewise-constant (low-res random class grid upsampled), so a
+    segmentation net can genuinely learn from color — loss decreases and mIoU
+    rises, which is what the e2e tests assert.
+    """
+    rng = np.random.default_rng(seed)
+    h, w = image_size
+    gh, gw = max(h // 32, 1), max(w // 32, 1)
+    grid = rng.integers(0, num_classes, size=(num_tiles, gh, gw))
+    # Ceil the upsample factor so the crop always has full h×w coverage even
+    # when gh/gw do not divide h/w exactly.
+    labels = np.repeat(np.repeat(grid, -(-h // gh), axis=1), -(-w // gw), axis=2)
+    labels = labels[:, :h, :w].astype(np.int32)
+    # One distinct color per class + noise.
+    palette = rng.uniform(0.1, 0.9, size=(num_classes, channels)).astype(np.float32)
+    images = palette[labels]  # [N,H,W,C]
+    images += rng.normal(0.0, 0.05, size=images.shape).astype(np.float32)
+    return TileDataset(np.clip(images, 0.0, 1.0), labels)
+
+
+def build_dataset(cfg: DataConfig) -> Tuple[TileDataset, TileDataset]:
+    """(train, test) pair from a DataConfig; synthetic when data_dir unset."""
+    if cfg.data_dir:
+        ds = load_tile_dir(cfg.data_dir, image_size=tuple(cfg.image_size))
+    else:
+        spec = DATASET_SPECS.get(cfg.dataset, DATASET_SPECS["synthetic"])
+        ds = SyntheticTiles(
+            num_tiles=cfg.synthetic_len,
+            image_size=tuple(cfg.image_size),
+            channels=spec["channels"],
+            num_classes=cfg.num_classes,
+            seed=cfg.seed,
+        )
+    return train_test_split(ds, cfg.test_split)

@@ -1,0 +1,225 @@
+"""Host-sharded batching: numpy tiles → globally-sharded jax.Arrays.
+
+This fixes the reference's central data defect: every replica there trains on
+the *same* 127 tiles in the *same* order (its shuffle is computed then never
+applied, кластер.py:722-723,750; SURVEY §3.1), so k replicas do k× redundant
+work.  Here one global permutation (same seed on every process) is sliced
+per-process, each host materializes only its slice, and
+``jax.make_array_from_process_local_data`` assembles the global sharded batch
+the compiled step consumes — the standard multi-host JAX input path, replacing
+nothing-in-the-reference (it has no sampler at all).
+
+Batch layout for the train step (parallel/train_step.py):
+  images [A, B, H, W, C], labels [A, B, H, W]
+A = sync_period micro-batches per optimizer step (reference
+``frequency_sending_gradients``, кластер.py:685), B = global micro-batch
+sharded over the mesh ``data`` axis (and H over ``space`` when used).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddlpc_tpu.data.datasets import TileDataset
+
+
+def make_global_array(
+    local: np.ndarray, mesh: Mesh, spec: P
+) -> jax.Array:
+    """Assemble a global sharded array from this process's local shard."""
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), local
+    )
+
+
+class ShardedLoader:
+    """Iterates (images, labels) super-batches, sharded over the mesh.
+
+    One "item" feeds one optimizer step: ``sync_period`` micro-batches of
+    global size ``global_micro_batch``.  Every process computes the same
+    epoch permutation (seeded), takes its contiguous per-process slice, and
+    uploads only that slice; leftover tiles that don't fill a super-batch are
+    dropped (static shapes for XLA).
+    """
+
+    def __init__(
+        self,
+        dataset: TileDataset,
+        mesh: Mesh,
+        global_micro_batch: int,
+        sync_period: int = 1,
+        shuffle: bool = True,
+        seed: int = 0,
+        data_axis: str = "data",
+        space_axis: Optional[str] = None,
+        prefetch: int = 2,
+    ):
+        self.ds = dataset
+        self.mesh = mesh
+        self.global_micro_batch = global_micro_batch
+        self.sync_period = sync_period
+        self.shuffle = shuffle
+        self.seed = seed
+        self.data_axis = data_axis
+        self.space_axis = space_axis
+        self.prefetch = prefetch
+        self._epoch = 0
+
+        nproc = jax.process_count()
+        if global_micro_batch % nproc:
+            raise ValueError(
+                f"global_micro_batch={global_micro_batch} must divide evenly "
+                f"across {nproc} processes"
+            )
+        data_size = mesh.shape.get(data_axis, 1)
+        if global_micro_batch % data_size:
+            raise ValueError(
+                f"global_micro_batch={global_micro_batch} must be divisible by "
+                f"the '{data_axis}' mesh axis size {data_size}"
+            )
+        self.local_micro_batch = global_micro_batch // nproc
+        self.super_batch = global_micro_batch * sync_period
+        if len(dataset) < self.super_batch:
+            raise ValueError(
+                f"dataset of {len(dataset)} tiles smaller than one super-batch "
+                f"({self.super_batch} = {global_micro_batch}×{sync_period}); "
+                f"reduce batch/sync_period or add data"
+            )
+        self.image_spec = P(None, data_axis, space_axis)  # [A, B, H, W, C]
+        self.label_spec = P(None, data_axis, space_axis)  # [A, B, H, W]
+
+    def __len__(self) -> int:
+        return len(self.ds) // self.super_batch
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    def _epoch_indices(self) -> np.ndarray:
+        idx = np.arange(len(self.ds))
+        if self.shuffle:
+            # Same permutation on every process (shared seed), like
+            # DistributedSampler.set_epoch; the per-process slice differs.
+            np.random.default_rng(self.seed + self._epoch).shuffle(idx)
+        return idx
+
+    def _local_batches(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        idx = self._epoch_indices()
+        pid = jax.process_index()
+        A, Bg, Bl = self.sync_period, self.global_micro_batch, self.local_micro_batch
+        for start in range(0, len(idx) - self.super_batch + 1, self.super_batch):
+            chunk = idx[start : start + self.super_batch].reshape(A, Bg)
+            local = chunk[:, pid * Bl : (pid + 1) * Bl]  # [A, B_local]
+            flat = local.reshape(-1)
+            imgs = self.ds.images[flat].reshape(
+                A, Bl, *self.ds.images.shape[1:]
+            )
+            labs = self.ds.labels[flat].reshape(A, Bl, *self.ds.labels.shape[1:])
+            yield imgs, labs
+
+    def _upload(self, item: Tuple[np.ndarray, np.ndarray]):
+        imgs, labs = item
+        return (
+            make_global_array(imgs, self.mesh, self.image_spec),
+            make_global_array(labs, self.mesh, self.label_spec),
+        )
+
+    def __iter__(self) -> Iterator[Tuple[jax.Array, jax.Array]]:
+        """Yield device-resident super-batches, prefetching uploads so the
+        host→HBM copy of batch k+1 overlaps the compute of batch k (the
+        reference's loop blocks the GPU on every host copy, кластер.py:754)."""
+        if self.prefetch <= 0:
+            for item in self._local_batches():
+                yield self._upload(item)
+            return
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        stop = object()
+        cancelled = threading.Event()
+
+        def producer():
+            try:
+                for item in self._local_batches():
+                    payload = self._upload(item)
+                    # Bounded put that aborts if the consumer went away, so an
+                    # early `break` can't leave this thread blocked forever
+                    # holding device-resident batches.
+                    while not cancelled.is_set():
+                        try:
+                            q.put(payload, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if cancelled.is_set():
+                        return
+            finally:
+                while not cancelled.is_set():
+                    try:
+                        q.put(stop, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is stop:
+                    break
+                yield item
+        finally:
+            cancelled.set()
+            while not q.empty():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join()
+
+
+def eval_batches(
+    dataset: TileDataset,
+    mesh: Mesh,
+    global_batch: int,
+    data_axis: str = "data",
+    space_axis: Optional[str] = None,
+) -> Iterator[Tuple[jax.Array, jax.Array]]:
+    """Fixed-order eval iterator; pads the tail batch by repeating the last
+    tile (static shapes for one compiled eval step) with labels set to -1,
+    which the confusion matrix masks out (ops/metrics.py), so padding never
+    pollutes mIoU."""
+    nproc, pid = jax.process_count(), jax.process_index()
+    if global_batch % nproc:
+        raise ValueError(
+            f"global_batch={global_batch} must be divisible by the process "
+            f"count {nproc}"
+        )
+    data_size = mesh.shape.get(data_axis, 1)
+    if global_batch % data_size:
+        raise ValueError(
+            f"global_batch={global_batch} must be divisible by the "
+            f"'{data_axis}' mesh axis size {data_size}"
+        )
+    bl = global_batch // nproc
+    spec_x = P(data_axis, space_axis)
+    spec_y = P(data_axis, space_axis)
+    n = len(dataset)
+    for start in range(0, n, global_batch):
+        idx = np.arange(start, min(start + global_batch, n))
+        valid = len(idx)
+        if valid < global_batch:
+            idx = np.concatenate([idx, np.full(global_batch - valid, idx[-1])])
+        local = idx[pid * bl : (pid + 1) * bl]
+        labels = dataset.labels[local].copy()
+        # Mark padded samples invalid: global positions >= valid.
+        global_pos = np.arange(pid * bl, (pid + 1) * bl)
+        labels[global_pos >= valid] = -1
+        yield (
+            make_global_array(dataset.images[local], mesh, spec_x),
+            make_global_array(labels, mesh, spec_y),
+        )

@@ -38,3 +38,25 @@ def softmax_cross_entropy(
         return nll.mean()
     valid = (labels != ignore_index).astype(jnp.float32)
     return (nll * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+
+
+def softmax_cross_entropy_sum(
+    logits: jax.Array,
+    labels: jax.Array,
+    ignore_index: Optional[int] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """(summed NLL, valid-pixel count) — for callers that combine shards:
+    psum both then divide, giving an exactly pixel-weighted global mean even
+    when shards hold different numbers of valid (non-padded) pixels."""
+    logits = logits.astype(jnp.float32)
+    num_classes = logits.shape[-1]
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    labels_clipped = jnp.clip(labels, 0, num_classes - 1)
+    nll = -jnp.take_along_axis(
+        log_probs, labels_clipped[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    if ignore_index is None:
+        valid = jnp.ones_like(nll)
+    else:
+        valid = (labels != ignore_index).astype(jnp.float32)
+    return (nll * valid).sum(), valid.sum()

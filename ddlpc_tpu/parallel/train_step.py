@@ -33,7 +33,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ddlpc_tpu.config import CompressionConfig, ExperimentConfig
-from ddlpc_tpu.ops.losses import softmax_cross_entropy
+from ddlpc_tpu.ops.losses import softmax_cross_entropy, softmax_cross_entropy_sum
 from ddlpc_tpu.ops.metrics import confusion_from_logits, pixel_accuracy
 from ddlpc_tpu.parallel.grad_sync import sync_gradients
 
@@ -111,6 +111,13 @@ def make_train_step(
     sharded over the data axis.
     Returns (new_state, metrics) with metrics averaged over A and the mesh.
     """
+    for name, size in mesh.shape.items():
+        if name != data_axis and size > 1:
+            raise NotImplementedError(
+                f"mesh axis {name!r} (size {size}) is not yet consumed by the "
+                f"train step — spatial halo sharding lands in parallel/halo.py; "
+                f"until then use a pure data mesh"
+            )
 
     def shard_body(state: TrainState, images: jax.Array, labels: jax.Array):
         # Inside shard_map: images [A, B_local, H, W, C].
@@ -183,10 +190,15 @@ def make_eval_step(
             train=False,
         )
         cm = confusion_from_logits(logits, labels, num_classes)
-        loss = softmax_cross_entropy(logits, labels)
+        # -1 marks batch-padding pixels from the eval loader (data/loader.py).
+        # Sum NLL and valid-pixel counts separately before dividing so shards
+        # that hold only padding get zero weight, not an unweighted 0.0 vote.
+        nll_sum, count = softmax_cross_entropy_sum(logits, labels, ignore_index=-1)
+        nll_sum = lax.psum(nll_sum, data_axis)
+        count = lax.psum(count, data_axis)
         return {
             "confusion": lax.psum(cm, data_axis),
-            "loss": lax.pmean(loss, data_axis),
+            "loss": nll_sum / jnp.maximum(count, 1.0),
         }
 
     sharded = jax.shard_map(
