@@ -11,6 +11,7 @@ from ddlpc_tpu.data.datasets import (  # noqa: F401
     SyntheticTiles,
     TileDataset,
     build_dataset,
+    dataset_defaults,
     train_test_split,
 )
 from ddlpc_tpu.data.loader import ShardedLoader, make_global_array  # noqa: F401

@@ -16,8 +16,7 @@ download; it is shape- and dtype-identical to the disk reader.
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass
-from typing import Iterator, Optional, Sequence, Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -135,7 +134,12 @@ def train_test_split(
 ) -> Tuple[TileDataset, TileDataset]:
     """Last-N holdout, reference behavior (кластер.py:672-673)."""
     n = len(ds)
-    k = min(max(test_split, 0), n - 1) if n > 1 else 0
+    k = max(test_split, 0)
+    if k >= n:
+        raise ValueError(
+            f"test_split={test_split} would leave no training tiles "
+            f"(dataset has {n}); lower DataConfig.test_split or add data"
+        )
     cut = n - k
     return (
         TileDataset(ds.images[:cut], ds.labels[:cut]),
@@ -171,16 +175,52 @@ def SyntheticTiles(
     return TileDataset(np.clip(images, 0.0, 1.0), labels)
 
 
+def dataset_defaults(name: str, **overrides) -> DataConfig:
+    """A DataConfig pre-filled with a known dataset's geometry
+    (BASELINE.json configs: vaihingen/potsdam 512×512 6-class,
+    cityscapes 512×1024 19-class)."""
+    spec = DATASET_SPECS[name]
+    kw = dict(
+        dataset=name,
+        image_size=spec["image_size"],
+        num_classes=spec["num_classes"],
+    )
+    kw.update(overrides)
+    return DataConfig(**kw)
+
+
 def build_dataset(cfg: DataConfig) -> Tuple[TileDataset, TileDataset]:
-    """(train, test) pair from a DataConfig; synthetic when data_dir unset."""
+    """(train, test) pair from a DataConfig; synthetic when data_dir unset.
+
+    ``cfg`` is authoritative; a mismatch with the named dataset's known
+    geometry (DATASET_SPECS) gets a warning so e.g. dataset='cityscapes'
+    with the default 6-class 512×512 config can't pass silently.  Use
+    :func:`dataset_defaults` to start from the right geometry.
+    """
+    spec = DATASET_SPECS.get(cfg.dataset)
+    if spec is not None and cfg.dataset != "synthetic":
+        if (
+            tuple(cfg.image_size) != spec["image_size"]
+            or cfg.num_classes != spec["num_classes"]
+        ):
+            import warnings
+
+            warnings.warn(
+                f"DataConfig({cfg.dataset!r}) has image_size={cfg.image_size}, "
+                f"num_classes={cfg.num_classes} but {cfg.dataset} is "
+                f"{spec['image_size']}, {spec['num_classes']} classes; the "
+                f"config wins — use dataset_defaults({cfg.dataset!r}) if "
+                f"this is unintended",
+                stacklevel=2,
+            )
     if cfg.data_dir:
         ds = load_tile_dir(cfg.data_dir, image_size=tuple(cfg.image_size))
     else:
-        spec = DATASET_SPECS.get(cfg.dataset, DATASET_SPECS["synthetic"])
+        channels = (spec or DATASET_SPECS["synthetic"])["channels"]
         ds = SyntheticTiles(
             num_tiles=cfg.synthetic_len,
             image_size=tuple(cfg.image_size),
-            channels=spec["channels"],
+            channels=channels,
             num_classes=cfg.num_classes,
             seed=cfg.seed,
         )

@@ -141,28 +141,28 @@ class ShardedLoader:
         stop = object()
         cancelled = threading.Event()
 
+        def put_or_cancel(payload) -> bool:
+            # Bounded put that aborts if the consumer went away, so an early
+            # `break` can't leave this thread blocked forever holding
+            # device-resident batches.
+            while not cancelled.is_set():
+                try:
+                    q.put(payload, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
         def producer():
             try:
                 for item in self._local_batches():
-                    payload = self._upload(item)
-                    # Bounded put that aborts if the consumer went away, so an
-                    # early `break` can't leave this thread blocked forever
-                    # holding device-resident batches.
-                    while not cancelled.is_set():
-                        try:
-                            q.put(payload, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
-                    if cancelled.is_set():
+                    if not put_or_cancel(self._upload(item)):
                         return
-            finally:
-                while not cancelled.is_set():
-                    try:
-                        q.put(stop, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
+                put_or_cancel(stop)
+            except BaseException as e:  # noqa: BLE001 — re-raised in consumer
+                # Hand the exception to the consumer instead of dying silently
+                # (which would end the epoch early with truncated data).
+                put_or_cancel(e)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
@@ -171,6 +171,8 @@ class ShardedLoader:
                 item = q.get()
                 if item is stop:
                     break
+                if isinstance(item, BaseException):
+                    raise item
                 yield item
         finally:
             cancelled.set()

@@ -13,6 +13,39 @@ import jax
 import jax.numpy as jnp
 
 
+def _per_pixel_nll(
+    logits: jax.Array, labels: jax.Array, label_smoothing: float = 0.0
+) -> jax.Array:
+    logits = logits.astype(jnp.float32)
+    num_classes = logits.shape[-1]
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    labels_clipped = jnp.clip(labels, 0, num_classes - 1)
+    nll = -jnp.take_along_axis(
+        log_probs, labels_clipped[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    if label_smoothing > 0.0:
+        smooth = -log_probs.mean(axis=-1)
+        nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
+    return nll
+
+
+def softmax_cross_entropy_sum(
+    logits: jax.Array,
+    labels: jax.Array,
+    ignore_index: Optional[int] = None,
+    label_smoothing: float = 0.0,
+) -> tuple[jax.Array, jax.Array]:
+    """(summed NLL, valid-pixel count) — for callers that combine shards or
+    batches: sum both then divide once, giving an exactly pixel-weighted mean
+    even when pieces hold different numbers of valid (non-padded) pixels."""
+    nll = _per_pixel_nll(logits, labels, label_smoothing)
+    if ignore_index is None:
+        valid = jnp.ones_like(nll)
+    else:
+        valid = (labels != ignore_index).astype(jnp.float32)
+    return (nll * valid).sum(), valid.sum()
+
+
 def softmax_cross_entropy(
     logits: jax.Array,
     labels: jax.Array,
@@ -24,39 +57,7 @@ def softmax_cross_entropy(
     logits: [..., C] float; labels: [...] int.  Matches torch
     CrossEntropyLoss (mean reduction) semantics on valid pixels.
     """
-    logits = logits.astype(jnp.float32)
-    num_classes = logits.shape[-1]
-    log_probs = jax.nn.log_softmax(logits, axis=-1)
-    labels_clipped = jnp.clip(labels, 0, num_classes - 1)
-    nll = -jnp.take_along_axis(
-        log_probs, labels_clipped[..., None].astype(jnp.int32), axis=-1
-    )[..., 0]
-    if label_smoothing > 0.0:
-        smooth = -log_probs.mean(axis=-1)
-        nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
-    if ignore_index is None:
-        return nll.mean()
-    valid = (labels != ignore_index).astype(jnp.float32)
-    return (nll * valid).sum() / jnp.maximum(valid.sum(), 1.0)
-
-
-def softmax_cross_entropy_sum(
-    logits: jax.Array,
-    labels: jax.Array,
-    ignore_index: Optional[int] = None,
-) -> tuple[jax.Array, jax.Array]:
-    """(summed NLL, valid-pixel count) — for callers that combine shards:
-    psum both then divide, giving an exactly pixel-weighted global mean even
-    when shards hold different numbers of valid (non-padded) pixels."""
-    logits = logits.astype(jnp.float32)
-    num_classes = logits.shape[-1]
-    log_probs = jax.nn.log_softmax(logits, axis=-1)
-    labels_clipped = jnp.clip(labels, 0, num_classes - 1)
-    nll = -jnp.take_along_axis(
-        log_probs, labels_clipped[..., None].astype(jnp.int32), axis=-1
-    )[..., 0]
-    if ignore_index is None:
-        valid = jnp.ones_like(nll)
-    else:
-        valid = (labels != ignore_index).astype(jnp.float32)
-    return (nll * valid).sum(), valid.sum()
+    nll_sum, count = softmax_cross_entropy_sum(
+        logits, labels, ignore_index, label_smoothing
+    )
+    return nll_sum / jnp.maximum(count, 1.0)

@@ -191,14 +191,14 @@ def make_eval_step(
         )
         cm = confusion_from_logits(logits, labels, num_classes)
         # -1 marks batch-padding pixels from the eval loader (data/loader.py).
-        # Sum NLL and valid-pixel counts separately before dividing so shards
-        # that hold only padding get zero weight, not an unweighted 0.0 vote.
+        # Return summed NLL and valid-pixel count, not a mean: the caller
+        # accumulates both across shards AND batches and divides once, so
+        # padded shards/tail batches get exactly their valid-pixel weight.
         nll_sum, count = softmax_cross_entropy_sum(logits, labels, ignore_index=-1)
-        nll_sum = lax.psum(nll_sum, data_axis)
-        count = lax.psum(count, data_axis)
         return {
             "confusion": lax.psum(cm, data_axis),
-            "loss": nll_sum / jnp.maximum(count, 1.0),
+            "loss_sum": lax.psum(nll_sum, data_axis),
+            "pixel_count": lax.psum(count, data_axis),
         }
 
     sharded = jax.shard_map(

@@ -1,0 +1,145 @@
+"""Observability: metrics logs, stage timing, qualitative image dumps.
+
+Reference parity (SURVEY §5): per-epoch loss/accuracy/timing lines appended
+to a txt file (кластер.py:715-716,781-782), wall-clock prints per sync stage
+(кластер.py:116,265,317,389,397,440), and 5 (prediction, label, image) PNG
+triples per epoch (кластер.py:785-790).  Here the txt log is kept (same
+human-readable shape) plus a machine-readable JSONL stream, timings come
+from a reusable ``StageTimer``, and the PNG dumps color classes through a
+fixed palette instead of the reference's ``pred*5`` grayscale trick.
+Only process 0 writes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+# ISPRS-style 6-class palette (imp surface, building, low veg, tree, car,
+# clutter) extended by hashing for datasets with more classes.
+_PALETTE = np.array(
+    [
+        [255, 255, 255],
+        [0, 0, 255],
+        [0, 255, 255],
+        [0, 255, 0],
+        [255, 255, 0],
+        [255, 0, 0],
+    ],
+    np.uint8,
+)
+
+
+def class_palette(num_classes: int) -> np.ndarray:
+    if num_classes <= len(_PALETTE):
+        return _PALETTE[:num_classes]
+    rng = np.random.default_rng(0)
+    extra = rng.integers(0, 256, size=(num_classes - len(_PALETTE), 3), dtype=np.uint8)
+    return np.concatenate([_PALETTE, extra])
+
+
+class MetricsLogger:
+    """Append-only txt + JSONL metric streams under ``workdir``.
+
+    txt mirrors the reference's epoch lines (кластер.py:781-782); JSONL is
+    the machine-readable record new in this framework.
+    """
+
+    def __init__(self, workdir: str, run_config_json: Optional[str] = None):
+        self.enabled = jax.process_index() == 0
+        self.workdir = workdir
+        if not self.enabled:
+            return
+        os.makedirs(workdir, exist_ok=True)
+        self.txt_path = os.path.join(workdir, "metrics.txt")
+        self.jsonl_path = os.path.join(workdir, "metrics.jsonl")
+        if run_config_json is not None:
+            # Run-config header, as the reference writes before epoch 0
+            # (кластер.py:715-716).
+            with open(os.path.join(workdir, "config.json"), "w") as f:
+                f.write(run_config_json)
+
+    def log(self, record: Dict[str, object], echo: bool = True) -> None:
+        if not self.enabled:
+            return
+        record = {
+            k: (float(v) if isinstance(v, (np.floating, jax.Array)) else v)
+            for k, v in record.items()
+        }
+        record.setdefault("time", time.time())
+        with open(self.jsonl_path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+        line = "  ".join(
+            f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in record.items()
+            if k != "time"
+        )
+        with open(self.txt_path, "a") as f:
+            f.write(line + "\n")
+        if echo:
+            print(line, flush=True)
+
+
+class StageTimer:
+    """Named wall-clock stage timing — the structured form of the
+    reference's scattered ``time.time()`` delta prints (кластер.py:265-440).
+    Accumulates totals; ``summary()`` gives seconds per stage."""
+
+    def __init__(self):
+        self.totals: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+
+    @contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def summary(self) -> Dict[str, float]:
+        return dict(self.totals)
+
+    def means(self) -> Dict[str, float]:
+        return {k: self.totals[k] / max(self.counts[k], 1) for k in self.totals}
+
+    def reset(self) -> None:
+        self.totals.clear()
+        self.counts.clear()
+
+
+def dump_prediction_triples(
+    workdir: str,
+    images: np.ndarray,
+    labels: np.ndarray,
+    preds: np.ndarray,
+    num_classes: int,
+    epoch: int,
+    max_samples: int = 5,
+) -> None:
+    """Write (Model i, Label i, Image i) PNG triples (кластер.py:785-790)."""
+    if jax.process_index() != 0:
+        return
+    from PIL import Image
+
+    out_dir = os.path.join(workdir, "images", f"epoch_{epoch:04d}")
+    os.makedirs(out_dir, exist_ok=True)
+    pal = class_palette(num_classes)
+    n = min(max_samples, len(images))
+    for i in range(n):
+        pred_rgb = pal[np.clip(preds[i], 0, num_classes - 1)]
+        lab_rgb = pal[np.clip(labels[i], 0, num_classes - 1)]
+        img_u8 = np.clip(images[i] * 255.0, 0, 255).astype(np.uint8)
+        if img_u8.shape[-1] == 1:
+            img_u8 = np.repeat(img_u8, 3, axis=-1)
+        Image.fromarray(pred_rgb).save(os.path.join(out_dir, f"Model {i}.png"))
+        Image.fromarray(lab_rgb).save(os.path.join(out_dir, f"Label {i}.png"))
+        Image.fromarray(img_u8).save(os.path.join(out_dir, f"Image {i}.png"))

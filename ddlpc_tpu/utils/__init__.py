@@ -1,0 +1,8 @@
+"""Utilities: wire codec (framed, block-parallel compression), timing."""
+
+from ddlpc_tpu.utils.wire import (  # noqa: F401
+    compress,
+    decompress,
+    pack_message,
+    unpack_message,
+)

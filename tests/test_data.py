@@ -149,6 +149,50 @@ def test_sharded_loader_too_small_raises(mesh):
         ShardedLoader(ds, mesh, global_micro_batch=8, sync_period=2)
 
 
+def test_prefetch_propagates_producer_errors(mesh):
+    """An exception while assembling/uploading a batch must surface in the
+    consumer, not silently truncate the epoch."""
+    ds = SyntheticTiles(num_tiles=32, image_size=(8, 8))
+    loader = ShardedLoader(ds, mesh, global_micro_batch=8, sync_period=1, prefetch=2)
+    boom = RuntimeError("upload failed")
+    calls = {"n": 0}
+    orig = loader._upload
+
+    def failing(item):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise boom
+        return orig(item)
+
+    loader._upload = failing
+    with pytest.raises(RuntimeError, match="upload failed"):
+        list(loader)
+
+
+def test_train_test_split_too_large_raises():
+    ds = SyntheticTiles(num_tiles=5, image_size=(8, 8))
+    with pytest.raises(ValueError, match="test_split"):
+        train_test_split(ds, 5)
+
+
+def test_build_dataset_warns_on_spec_mismatch():
+    cfg = DataConfig(
+        dataset="cityscapes", image_size=(32, 32), num_classes=6,
+        synthetic_len=10, test_split=2,
+    )
+    with pytest.warns(UserWarning, match="cityscapes"):
+        build_dataset(cfg)
+
+
+def test_dataset_defaults():
+    from ddlpc_tpu.data import dataset_defaults
+
+    cfg = dataset_defaults("cityscapes", synthetic_len=8, test_split=2)
+    assert cfg.image_size == (512, 1024)
+    assert cfg.num_classes == 19
+    assert cfg.synthetic_len == 8
+
+
 def test_eval_batches_padding_masks_labels(mesh):
     ds = SyntheticTiles(num_tiles=10, image_size=(8, 8))
     batches = list(eval_batches(ds, mesh, global_batch=8))

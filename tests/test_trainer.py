@@ -1,0 +1,155 @@
+"""Trainer driver, checkpoint/resume, observability, CLI (SURVEY §7 steps
+5/8: the subsystems the reference lacks entirely)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from ddlpc_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from ddlpc_tpu.train import checkpoint as ckpt
+from ddlpc_tpu.train.observability import MetricsLogger, StageTimer, dump_prediction_triples
+from ddlpc_tpu.train.trainer import Trainer
+
+
+def tiny_config(workdir: str, **train_kw) -> ExperimentConfig:
+    return ExperimentConfig(
+        model=ModelConfig(
+            features=(8, 16), bottleneck_features=16, num_classes=4
+        ),
+        data=DataConfig(
+            image_size=(32, 32), synthetic_len=40, test_split=8, num_classes=4
+        ),
+        train=TrainConfig(
+            epochs=2,
+            micro_batch_size=1,
+            sync_period=2,
+            learning_rate=3e-3,
+            dump_images_per_epoch=2,
+            **train_kw,
+        ),
+        workdir=workdir,
+    )
+
+
+@pytest.fixture(scope="module")
+def run(tmp_path_factory):
+    """One short fit() shared by the assertions below (compile is the cost)."""
+    workdir = str(tmp_path_factory.mktemp("run"))
+    trainer = Trainer(tiny_config(workdir))
+    record = trainer.fit()
+    return workdir, trainer, record
+
+
+def test_fit_trains_and_evaluates(run):
+    _, _, record = run
+    assert record["epoch"] == 1
+    assert np.isfinite(record["loss"])
+    assert 0.0 <= record["val_miou"] <= 1.0
+    assert 0.0 <= record["val_pixel_acc"] <= 1.0
+    assert record["tiles_per_s"] > 0
+
+
+def test_fit_writes_logs_and_config(run):
+    workdir, _, _ = run
+    lines = open(os.path.join(workdir, "metrics.jsonl")).read().splitlines()
+    assert len(lines) == 2  # one per epoch
+    rec = json.loads(lines[-1])
+    assert "loss" in rec and "val_miou" in rec and "epoch_time_s" in rec
+    assert os.path.exists(os.path.join(workdir, "metrics.txt"))
+    cfg = json.load(open(os.path.join(workdir, "config.json")))
+    assert cfg["train"]["sync_period"] == 2
+
+
+def test_fit_dumps_prediction_triples(run):
+    workdir, _, _ = run
+    img_dir = os.path.join(workdir, "images", "epoch_0001")
+    names = sorted(os.listdir(img_dir))
+    # (Model i, Label i, Image i) triples, reference кластер.py:785-790.
+    assert names == [
+        "Image 0.png", "Image 1.png", "Label 0.png", "Label 1.png",
+        "Model 0.png", "Model 1.png",
+    ]
+
+
+def test_checkpoint_resume_continues(run):
+    workdir, trainer, record = run
+    # Checkpoints exist and resuming picks up after the last epoch.
+    assert ckpt.latest_step(os.path.join(workdir, "checkpoints")) is not None
+    resumed = Trainer(tiny_config(workdir))
+    assert resumed.start_epoch == 2
+    # Restored parameters equal the live ones.
+    live = jax.tree.leaves(trainer.state.params)
+    rest = jax.tree.leaves(resumed.state.params)
+    for a, b in zip(live, rest):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    # fit() with the same epoch budget is a no-op after resume.
+    rec2 = resumed.fit()
+    assert rec2 == {}
+
+
+def test_checkpoint_prune_and_atomicity(tmp_path):
+    state = {"w": np.arange(10, dtype=np.float32)}
+    d = str(tmp_path / "ck")
+    for step in range(5):
+        ckpt.save_checkpoint(d, state, step=step, metadata={"epoch": step}, keep=2)
+    assert ckpt._steps(d) == [3, 4]
+    restored, meta = ckpt.restore_checkpoint(d, {"w": np.zeros(10, np.float32)})
+    np.testing.assert_array_equal(restored["w"], state["w"])
+    assert meta["epoch"] == 4
+    assert not [f for f in os.listdir(d) if f.endswith(".tmp")]
+
+
+def test_checkpoint_restore_missing_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore_checkpoint(str(tmp_path / "none"), {"w": np.zeros(1)})
+
+
+def test_stage_timer():
+    t = StageTimer()
+    with t.stage("a"):
+        pass
+    with t.stage("a"):
+        pass
+    assert t.counts["a"] == 2 and t.totals["a"] >= 0
+    assert set(t.means()) == {"a"}
+    t.reset()
+    assert t.summary() == {}
+
+
+def test_metrics_logger_types(tmp_path):
+    log = MetricsLogger(str(tmp_path))
+    log.log({"epoch": 1, "loss": np.float32(0.5)}, echo=False)
+    rec = json.loads(open(tmp_path / "metrics.jsonl").read())
+    assert rec["loss"] == 0.5 and rec["epoch"] == 1 and "time" in rec
+
+
+def test_cli_overrides(tmp_path):
+    from ddlpc_tpu.train.__main__ import parse_config
+
+    cfg_file = tmp_path / "c.json"
+    cfg_file.write_text(tiny_config(str(tmp_path)).to_json())
+    cfg, resume = parse_config(
+        [
+            "--config", str(cfg_file),
+            "--set", "train.epochs=7",
+            "--set", "model.name=unet",
+            "--set", "data.image_size=(64,64)",
+            "--workdir", str(tmp_path / "w"),
+            "--no-resume",
+        ]
+    )
+    assert cfg.train.epochs == 7
+    assert cfg.data.image_size == (64, 64)
+    assert cfg.workdir == str(tmp_path / "w")
+    assert resume is False
+    with pytest.raises(KeyError):
+        parse_config(["--set", "train.nope=1"])

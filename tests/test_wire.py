@@ -1,0 +1,49 @@
+"""Wire codec: framed block compression + message framing (reference L0/L1,
+кластер.py:43-102)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ddlpc_tpu.utils import wire
+
+
+@pytest.mark.parametrize("size", [0, 1, 100, wire.BLOCK_SIZE, 3 * wire.BLOCK_SIZE + 17])
+def test_compress_roundtrip(size):
+    rng = np.random.default_rng(size)
+    # Half-compressible payload: repeated pattern + noise.
+    data = (b"segmentation" * (size // 24 + 1))[: size // 2]
+    data += rng.integers(0, 256, size - len(data), dtype=np.uint8).tobytes()
+    assert wire.decompress(wire.compress(data)) == data
+
+
+def test_compress_actually_compresses():
+    data = b"tile" * 100_000
+    comp = wire.compress(data)
+    assert len(comp) < len(data) // 10
+
+
+def test_decompress_rejects_bad_magic():
+    with pytest.raises(ValueError, match="magic"):
+        wire.decompress(b"NOPE" + b"\x00" * 16)
+
+
+def test_decompress_rejects_trailing_garbage():
+    comp = wire.compress(b"hello") + b"extra"
+    with pytest.raises(ValueError, match="trailing"):
+        wire.decompress(comp)
+
+
+def test_message_framing_roundtrip():
+    payload = os.urandom(1000)
+    buf = wire.pack_message(payload) + b"rest"
+    got, rest = wire.unpack_message(buf)
+    assert got == payload and rest == b"rest"
+
+
+def test_message_framing_truncated():
+    with pytest.raises(ValueError, match="truncated"):
+        wire.unpack_message(b"\x10\x00\x00\x00abc")
+    with pytest.raises(ValueError, match="truncated"):
+        wire.unpack_message(b"\x01")
