@@ -1,0 +1,115 @@
+"""Headline benchmark: U-Net/Vaihingen training throughput per chip.
+
+Runs the flagship configuration (half-width U-Net as the reference's
+``NN_in_model=2``, кластер.py:687; 512×512×3 tiles, 6 classes) through the
+real compiled SPMD train step — forward, backward, gradient accumulation,
+all-reduce, Adam — on all available devices and reports steady-state
+training throughput in tiles/sec/chip.
+
+Baseline: BASELINE.md target ≥400 tiles/sec/chip on v5e-8 (the reference
+itself publishes no numbers, SURVEY §6).  Prints exactly one JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N/400}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ddlpc_tpu.config import (
+    CompressionConfig,
+    DataConfig,
+    ExperimentConfig,
+    ModelConfig,
+    ParallelConfig,
+    TrainConfig,
+)
+from ddlpc_tpu.models import build_model_from_experiment
+from ddlpc_tpu.parallel.mesh import make_mesh
+from ddlpc_tpu.parallel.train_step import create_train_state, make_train_step
+from ddlpc_tpu.train.optim import build_optimizer
+
+BASELINE_TILES_PER_SEC_PER_CHIP = 400.0
+
+# Benchmark shape: A micro-batches of (B_per_chip × 512 × 512 × 3) per step.
+TILE = 512
+MICRO_BATCH_PER_CHIP = 8
+SYNC_PERIOD = 4
+# The tunneled device has a large one-time cost on the first couple of
+# executions (program upload) — warm up past it, with a value fetch per call
+# so the warmup actually completes before timing starts.
+WARMUP_STEPS = 3
+TIMED_STEPS = 12
+
+
+def main() -> None:
+    n_devices = len(jax.devices())
+    cfg = ExperimentConfig(
+        model=ModelConfig(width_divisor=2, num_classes=6),
+        data=DataConfig(image_size=(TILE, TILE)),
+        train=TrainConfig(
+            micro_batch_size=MICRO_BATCH_PER_CHIP, sync_period=SYNC_PERIOD
+        ),
+        parallel=ParallelConfig(),
+        compression=CompressionConfig(mode="none"),
+    )
+    mesh = make_mesh(cfg.parallel)
+    model = build_model_from_experiment(cfg)
+    tx = build_optimizer(cfg.train)
+    state = create_train_state(
+        model, tx, jax.random.key(0), (1, TILE, TILE, 3)
+    )
+    step = make_train_step(model, tx, mesh, cfg.compression)
+
+    global_batch = MICRO_BATCH_PER_CHIP * n_devices
+    rng = np.random.default_rng(0)
+    images = jax.device_put(
+        rng.uniform(0, 1, (SYNC_PERIOD, global_batch, TILE, TILE, 3)).astype(
+            np.float32
+        ),
+        NamedSharding(mesh, P(None, "data")),
+    )
+    labels = jax.device_put(
+        rng.integers(0, 6, (SYNC_PERIOD, global_batch, TILE, TILE)).astype(
+            np.int32
+        ),
+        NamedSharding(mesh, P(None, "data")),
+    )
+
+    for _ in range(WARMUP_STEPS):
+        state, metrics = step(state, images, labels)
+        # Value fetch per call: block_until_ready alone does not synchronize
+        # on tunneled remote devices.
+        float(metrics["loss"])
+
+    times = []
+    for _ in range(TIMED_STEPS):
+        t0 = time.perf_counter()
+        state, metrics = step(state, images, labels)
+        float(metrics["loss"])
+        times.append(time.perf_counter() - t0)
+    # Median per-step time: robust to transient tunnel contention.
+    dt = float(np.median(times))
+
+    tiles_per_step = SYNC_PERIOD * global_batch
+    tiles_per_sec_per_chip = tiles_per_step / dt / n_devices
+    print(
+        json.dumps(
+            {
+                "metric": "unet_vaihingen512_train_tiles_per_sec_per_chip",
+                "value": round(tiles_per_sec_per_chip, 2),
+                "unit": "tiles/s/chip",
+                "vs_baseline": round(
+                    tiles_per_sec_per_chip / BASELINE_TILES_PER_SEC_PER_CHIP, 3
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

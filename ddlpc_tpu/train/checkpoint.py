@@ -61,6 +61,14 @@ def save_checkpoint(
     os.makedirs(ckpt_dir, exist_ok=True)
     blob = _compress(serialization.to_bytes(_to_host(state)))
     name = f"ckpt_{step}.msgpack.z"
+    # Metadata is renamed into place BEFORE the blob: latest_step() keys on
+    # the blob, so a crash between the two renames leaves either a harmless
+    # orphan .json or nothing — never a restorable blob with lost metadata.
+    meta = dict(metadata or {}, step=step)
+    meta_tmp = os.path.join(ckpt_dir, f".meta_{step}.tmp")
+    with open(meta_tmp, "w") as f:
+        json.dump(meta, f, indent=2)
+    os.replace(meta_tmp, os.path.join(ckpt_dir, f"ckpt_{step}.json"))
     fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
@@ -70,11 +78,6 @@ def save_checkpoint(
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
-    meta = dict(metadata or {}, step=step)
-    meta_tmp = os.path.join(ckpt_dir, f".meta_{step}.tmp")
-    with open(meta_tmp, "w") as f:
-        json.dump(meta, f, indent=2)
-    os.replace(meta_tmp, os.path.join(ckpt_dir, f"ckpt_{step}.json"))
     _prune(ckpt_dir, keep)
     return os.path.join(ckpt_dir, name)
 

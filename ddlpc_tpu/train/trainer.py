@@ -54,6 +54,12 @@ class Trainer:
     def __init__(self, cfg: ExperimentConfig, resume: bool = True):
         initialize_distributed()
         self.cfg = cfg
+        if cfg.model.num_classes != cfg.data.num_classes:
+            raise ValueError(
+                f"model.num_classes={cfg.model.num_classes} != "
+                f"data.num_classes={cfg.data.num_classes}: the loss would "
+                f"silently clip out-of-range labels and mIoU would drop them"
+            )
         self.mesh = make_mesh(cfg.parallel)
         data_size = self.mesh.shape[cfg.parallel.data_axis_name]
         self.global_micro_batch = cfg.train.micro_batch_size * data_size
@@ -100,12 +106,42 @@ class Trainer:
         self.workdir = cfg.workdir
         self.ckpt_dir = os.path.join(self.workdir, "checkpoints")
         self.start_epoch = 0
-        if resume and ckpt.latest_step(self.ckpt_dir) is not None:
-            self.state, meta = ckpt.restore_checkpoint(self.ckpt_dir, self.state)
-            self.state = jax.device_put(self.state, NamedSharding(self.mesh, P()))
-            self.start_epoch = int(meta.get("epoch", -1)) + 1
+        if resume:
+            self._restore_synchronized()
         self.logger = MetricsLogger(self.workdir, run_config_json=cfg.to_json())
         self.timer = StageTimer()
+
+    def _restore_synchronized(self) -> None:
+        """Resume with process 0 as the single source of truth.
+
+        Only process 0 writes checkpoints (checkpoint.py), so on non-shared
+        storage other hosts may see nothing — deciding locally would
+        desynchronize the SPMD program (mismatched collective counts hang the
+        pod).  Process 0 decides; both the resume epoch and the restored
+        state are broadcast to every process.
+        """
+        if jax.process_count() == 1:
+            if ckpt.latest_step(self.ckpt_dir) is not None:
+                self.state, meta = ckpt.restore_checkpoint(self.ckpt_dir, self.state)
+                self.state = jax.device_put(
+                    self.state, NamedSharding(self.mesh, P())
+                )
+                self.start_epoch = int(meta.get("epoch", -1)) + 1
+            return
+        from jax.experimental import multihost_utils
+
+        if jax.process_index() == 0 and ckpt.latest_step(self.ckpt_dir) is not None:
+            state, meta = ckpt.restore_checkpoint(self.ckpt_dir, self.state)
+            epoch_next = int(meta.get("epoch", -1)) + 1
+        else:
+            state, epoch_next = self.state, 0
+        epoch_next = int(
+            multihost_utils.broadcast_one_to_all(np.int32(epoch_next))
+        )
+        if epoch_next > 0:
+            state = multihost_utils.broadcast_one_to_all(state)
+            self.state = jax.device_put(state, NamedSharding(self.mesh, P()))
+            self.start_epoch = epoch_next
 
     # ------------------------------------------------------------------
 

@@ -68,9 +68,9 @@ def compress(data: bytes, level: int = LEVEL, block_size: int = BLOCK_SIZE) -> b
     view = memoryview(data)
     blocks = [view[i : i + block_size] for i in range(0, len(data), block_size)]
     if len(blocks) <= 1:
-        comps = [zlib.compress(bytes(b), level) for b in blocks]
+        comps = [zlib.compress(b, level) for b in blocks]
     else:
-        comps = list(_get_pool().map(lambda b: zlib.compress(bytes(b), level), blocks))
+        comps = list(_get_pool().map(lambda b: zlib.compress(b, level), blocks))
     out = [MAGIC, struct.pack("<I", len(blocks))]
     for raw, comp in zip(blocks, comps):
         out.append(struct.pack("<II", len(raw), len(comp)))
@@ -85,12 +85,18 @@ def decompress(data: bytes) -> bytes:
         return native.decompress(data)
     if data[:4] != MAGIC:
         raise ValueError("bad wire magic; not a DWZ1 frame")
+    if len(data) < 8:
+        raise ValueError("truncated frame: missing block count")
     (nblk,) = struct.unpack_from("<I", data, 4)
     off = 8
     metas = []
     for _ in range(nblk):
+        if off + 8 > len(data):
+            raise ValueError("truncated frame: missing block header")
         raw_len, comp_len = struct.unpack_from("<II", data, off)
         off += 8
+        if off + comp_len > len(data):
+            raise ValueError("truncated frame: missing block payload")
         metas.append((raw_len, data[off : off + comp_len]))
         off += comp_len
     if off != len(data):

@@ -113,6 +113,15 @@ def test_checkpoint_restore_missing_raises(tmp_path):
         ckpt.restore_checkpoint(str(tmp_path / "none"), {"w": np.zeros(1)})
 
 
+def test_model_data_class_mismatch_raises(tmp_path):
+    import dataclasses
+
+    cfg = tiny_config(str(tmp_path))
+    cfg = cfg.replace(model=dataclasses.replace(cfg.model, num_classes=7))
+    with pytest.raises(ValueError, match="num_classes"):
+        Trainer(cfg)
+
+
 def test_stage_timer():
     t = StageTimer()
     with t.stage("a"):

@@ -29,6 +29,13 @@ def test_decompress_rejects_bad_magic():
         wire.decompress(b"NOPE" + b"\x00" * 16)
 
 
+def test_decompress_rejects_truncation_with_value_error():
+    comp = wire.compress(b"hello world" * 1000)
+    for cut in (6, 10, len(comp) - 3):
+        with pytest.raises(ValueError, match="truncated"):
+            wire.decompress(comp[:cut])
+
+
 def test_decompress_rejects_trailing_garbage():
     comp = wire.compress(b"hello") + b"extra"
     with pytest.raises(ValueError, match="trailing"):
