@@ -12,7 +12,9 @@ from typing import Optional
 from flax import linen as nn
 
 from ddlpc_tpu.config import ModelConfig
+from ddlpc_tpu.models.deeplabv3p import DeepLabV3Plus
 from ddlpc_tpu.models.unet import UNet
+from ddlpc_tpu.models.unetpp import UNetPP
 
 _REGISTRY = {}
 
@@ -35,6 +37,39 @@ def _build_unet(cfg: ModelConfig, norm_axis_name: Optional[str]) -> nn.Module:
         bottleneck_features=cfg.bottleneck_features,
         width_divisor=cfg.width_divisor,
         up_sample_mode=cfg.up_sample_mode,
+        norm=cfg.norm,
+        norm_axis_name=norm_axis_name,
+        norm_groups=cfg.group_norm_groups,
+        dtype=jnp.dtype(cfg.compute_dtype),
+    )
+
+
+@register("unetpp")
+def _build_unetpp(cfg: ModelConfig, norm_axis_name: Optional[str]) -> nn.Module:
+    import jax.numpy as jnp
+
+    return UNetPP(
+        num_classes=cfg.num_classes,
+        features=tuple(cfg.features),
+        width_divisor=cfg.width_divisor,
+        up_sample_mode=cfg.up_sample_mode,
+        norm=cfg.norm,
+        norm_axis_name=norm_axis_name,
+        norm_groups=cfg.group_norm_groups,
+        deep_supervision=cfg.deep_supervision,
+        dtype=jnp.dtype(cfg.compute_dtype),
+    )
+
+
+@register("deeplabv3p")
+def _build_deeplab(cfg: ModelConfig, norm_axis_name: Optional[str]) -> nn.Module:
+    import jax.numpy as jnp
+
+    return DeepLabV3Plus(
+        num_classes=cfg.num_classes,
+        width_divisor=cfg.width_divisor,
+        output_stride=cfg.output_stride,
+        aspp_rates=tuple(cfg.aspp_rates),
         norm=cfg.norm,
         norm_axis_name=norm_axis_name,
         norm_groups=cfg.group_norm_groups,

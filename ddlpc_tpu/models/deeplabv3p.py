@@ -1,0 +1,200 @@
+"""DeepLabV3+ — atrous (dilated) convolutions + ASPP + light decoder.
+
+Required by BASELINE.json config 4 ("DeepLabV3+ / Potsdam 512×512, atrous
+conv, larger activations"); absent from the reference (plain U-Net only,
+кластер.py:620-656).  TPU-first choices: NHWC throughout, bf16 compute with
+fp32 params, residual encoder with stride-16 output (last stage dilated
+instead of strided, Chen et al. 2018), global pooling branch broadcast back
+to the feature map, all upsampling via bilinear resize (static shapes).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ddlpc_tpu.models.layers import ConvNormAct, Norm
+
+
+class ResidualBlock(nn.Module):
+    """Two 3×3 convs with a projection shortcut when shape changes."""
+
+    features: int
+    stride: int = 1
+    dilation: int = 1
+    norm: str = "batch"
+    norm_axis_name: Optional[str] = None
+    norm_groups: int = 8
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        norm_kw = dict(
+            kind=self.norm,
+            axis_name=self.norm_axis_name,
+            groups=self.norm_groups,
+            dtype=self.dtype,
+        )
+        shortcut = x
+        y = nn.Conv(
+            self.features,
+            (3, 3),
+            strides=(self.stride, self.stride),
+            kernel_dilation=(self.dilation, self.dilation),
+            padding="SAME",
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )(x)
+        y = nn.relu(Norm(**norm_kw)(y, train))
+        y = nn.Conv(
+            self.features,
+            (3, 3),
+            kernel_dilation=(self.dilation, self.dilation),
+            padding="SAME",
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )(y)
+        y = Norm(**norm_kw)(y, train)
+        if shortcut.shape[-1] != self.features or self.stride != 1:
+            shortcut = nn.Conv(
+                self.features,
+                (1, 1),
+                strides=(self.stride, self.stride),
+                use_bias=False,
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+            )(shortcut)
+            shortcut = Norm(**norm_kw)(shortcut, train)
+        return nn.relu(y + shortcut)
+
+
+class ASPP(nn.Module):
+    """Atrous Spatial Pyramid Pooling: 1×1 + dilated 3×3 branches + global
+    pooling, fused by a 1×1 conv."""
+
+    features: int = 256
+    rates: Sequence[int] = (6, 12, 18)
+    norm: str = "batch"
+    norm_axis_name: Optional[str] = None
+    norm_groups: int = 8
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        common = dict(
+            norm=self.norm,
+            norm_axis_name=self.norm_axis_name,
+            norm_groups=self.norm_groups,
+            dtype=self.dtype,
+        )
+        branches = [
+            ConvNormAct(self.features, kernel_size=(1, 1), **common)(x, train)
+        ]
+        for rate in self.rates:
+            branches.append(
+                ConvNormAct(self.features, dilation=rate, **common)(x, train)
+            )
+        # Image-level pooling branch: global mean → 1×1 conv → broadcast.
+        pooled = x.mean(axis=(1, 2), keepdims=True)
+        pooled = ConvNormAct(self.features, kernel_size=(1, 1), **common)(
+            pooled, train
+        )
+        branches.append(
+            jnp.broadcast_to(pooled, (*x.shape[:3], self.features)).astype(
+                self.dtype
+            )
+        )
+        y = jnp.concatenate(branches, axis=-1)
+        return ConvNormAct(self.features, kernel_size=(1, 1), **common)(y, train)
+
+
+def _resize_to(x: jax.Array, hw: Tuple[int, int]) -> jax.Array:
+    n, _, _, c = x.shape
+    return jax.image.resize(x, (n, *hw, c), method="bilinear").astype(x.dtype)
+
+
+class DeepLabV3Plus(nn.Module):
+    num_classes: int = 6
+    # Encoder stage widths (stem + 4 stages).
+    features: Tuple[int, ...] = (64, 128, 256, 512)
+    stem_features: int = 64
+    blocks_per_stage: int = 2
+    width_divisor: int = 1
+    output_stride: int = 16  # 16 (dilate last stage) or 8 (last two)
+    aspp_features: int = 256
+    aspp_rates: Sequence[int] = (6, 12, 18)
+    decoder_low_level_features: int = 48
+    decoder_features: int = 256
+    norm: str = "batch"
+    norm_axis_name: Optional[str] = None
+    norm_groups: int = 8
+    dtype: Any = jnp.bfloat16
+
+    def _w(self, f: int) -> int:
+        return max(1, f // self.width_divisor)
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        """x: [N,H,W,C], H and W divisible by output_stride.
+        Returns logits [N,H,W,num_classes] float32."""
+        if self.output_stride not in (8, 16):
+            raise ValueError(f"output_stride must be 8 or 16, got {self.output_stride}")
+        x = x.astype(self.dtype)
+        in_hw = x.shape[1:3]
+        common = dict(
+            norm=self.norm,
+            norm_axis_name=self.norm_axis_name,
+            norm_groups=self.norm_groups,
+            dtype=self.dtype,
+        )
+        # Stem: stride-2 conv + pool → stride 4.
+        y = ConvNormAct(self._w(self.stem_features), **common)(x, train)
+        y = nn.max_pool(y, (3, 3), strides=(2, 2), padding="SAME")
+        y = nn.max_pool(y, (3, 3), strides=(2, 2), padding="SAME")
+        low_level = None
+        # Stage strides for output_stride 16: (1, 2, 2→dilated); for 8 the
+        # last two stages are dilated.
+        stage_cfg = []
+        stride_so_far = 4
+        dilation = 1
+        for f in self.features:
+            if stride_so_far >= self.output_stride:
+                dilation *= 2
+                stage_cfg.append((f, 1, dilation))
+            else:
+                stride = 1 if not stage_cfg else 2
+                stride_so_far *= stride
+                stage_cfg.append((f, stride, 1))
+        for s, (f, stride, dil) in enumerate(stage_cfg):
+            for b in range(self.blocks_per_stage):
+                y = ResidualBlock(
+                    self._w(f),
+                    stride=stride if b == 0 else 1,
+                    dilation=dil,
+                    name=f"stage{s}_block{b}",
+                    **common,
+                )(y, train)
+            if s == 0:
+                low_level = y  # stride-4 features for the decoder
+        y = ASPP(
+            self._w(self.aspp_features),
+            rates=self.aspp_rates,
+            **common,
+        )(y, train)
+        # Decoder: ×(output_stride/4) up to the low-level grid, concat, fuse.
+        y = _resize_to(y, low_level.shape[1:3])
+        ll = ConvNormAct(
+            self._w(self.decoder_low_level_features), kernel_size=(1, 1), **common
+        )(low_level, train)
+        y = jnp.concatenate([y, ll], axis=-1)
+        y = ConvNormAct(self._w(self.decoder_features), **common)(y, train)
+        y = ConvNormAct(self._w(self.decoder_features), **common)(y, train)
+        logits = nn.Conv(
+            self.num_classes, (1, 1), dtype=jnp.float32, param_dtype=jnp.float32
+        )(y.astype(jnp.float32))
+        return _resize_to(logits, in_hw)

@@ -1,0 +1,88 @@
+"""U-Net++ (nested U-Net with dense skips and deep supervision).
+
+Required by BASELINE.json config 3 ("U-Net++ / Vaihingen, deep-supervision
+decoder, stresses conv fusion"); absent from the reference, whose only model
+is plain U-Net (кластер.py:620-656).  Shares the reference-parity building
+blocks (DoubleConv/max-pool/UpBlock, models/layers.py) so width_divisor,
+norm selection and up-sample mode behave identically across the zoo.
+
+Architecture (Zhou et al. 2018): node X[i][j] at depth i receives the
+concatenation of all same-depth predecessors X[i][0..j-1] plus the upsampled
+X[i+1][j-1].  With deep supervision each X[0][j], j≥1 gets a 1×1 logit head;
+training averages the heads' losses (here: averages the logits, equivalent
+up to the softmax nonlinearity and standard practice for inference pruning),
+and inference can stop at any head.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ddlpc_tpu.models.layers import DoubleConv, UpBlock, max_pool_2x2
+
+
+class UNetPP(nn.Module):
+    num_classes: int = 6
+    features: Tuple[int, ...] = (32, 64, 128, 256, 512)
+    width_divisor: int = 1
+    up_sample_mode: str = "conv_transpose"
+    norm: str = "batch"
+    norm_axis_name: Optional[str] = None
+    norm_groups: int = 8
+    deep_supervision: bool = True
+    dtype: Any = jnp.bfloat16
+
+    def _w(self, f: int) -> int:
+        return max(1, f // self.width_divisor)
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        """x: [N,H,W,C] float; H, W divisible by 2**(len(features)-1).
+        Returns logits [N,H,W,num_classes] float32 (deep supervision: the
+        mean of all supervised heads)."""
+        x = x.astype(self.dtype)
+        depth = len(self.features)
+        common = dict(
+            norm=self.norm,
+            norm_axis_name=self.norm_axis_name,
+            norm_groups=self.norm_groups,
+            dtype=self.dtype,
+        )
+        # Encoder backbone: X[i][0].
+        grid: dict[tuple[int, int], jax.Array] = {}
+        h = x
+        for i, f in enumerate(self.features):
+            h_out = DoubleConv(self._w(f), name=f"x{i}_0", **common)(h, train)
+            grid[(i, 0)] = h_out
+            if i < depth - 1:
+                h = max_pool_2x2(h_out)
+        # Nested decoder: X[i][j] = Up(X[i+1][j-1]) ++ X[i][0..j-1].
+        for j in range(1, depth):
+            for i in range(depth - j):
+                skips = [grid[(i, k)] for k in range(j)]
+                grid[(i, j)] = UpBlock(
+                    self._w(self.features[i]),
+                    up_sample_mode=self.up_sample_mode,
+                    name=f"x{i}_{j}",
+                    **common,
+                )(grid[(i + 1, j - 1)], skips, train)
+
+        def head(h: jax.Array, name: str) -> jax.Array:
+            return nn.Conv(
+                self.num_classes,
+                (1, 1),
+                dtype=jnp.float32,
+                param_dtype=jnp.float32,
+                name=name,
+            )(h.astype(jnp.float32))
+
+        if self.deep_supervision:
+            logits = [
+                head(grid[(0, j)], f"head_{j}") for j in range(1, depth)
+            ]
+            return jnp.mean(jnp.stack(logits), axis=0)
+        return head(grid[(0, depth - 1)], "head")

@@ -94,11 +94,20 @@ def _steps(ckpt_dir: str) -> list[int]:
 
 
 def _prune(ckpt_dir: str, keep: int) -> None:
-    for step in _steps(ckpt_dir)[:-keep] if keep > 0 else []:
+    live = _steps(ckpt_dir)
+    for step in live[:-keep] if keep > 0 else []:
         for suffix in (".msgpack.z", ".json"):
             path = os.path.join(ckpt_dir, f"ckpt_{step}{suffix}")
             if os.path.exists(path):
                 os.unlink(path)
+    # Sweep metadata orphaned by a crash between the json and blob renames
+    # (save order writes json first) — a .json with no blob is never a
+    # restorable step and would otherwise accumulate forever.
+    alive = set(_steps(ckpt_dir))
+    for name in os.listdir(ckpt_dir):
+        m = re.match(r"^ckpt_(\d+)\.json$", name)
+        if m and int(m.group(1)) not in alive:
+            os.unlink(os.path.join(ckpt_dir, name))
 
 
 def latest_step(ckpt_dir: str) -> Optional[int]:

@@ -132,13 +132,19 @@ class Trainer:
 
         if jax.process_index() == 0 and ckpt.latest_step(self.ckpt_dir) is not None:
             state, meta = ckpt.restore_checkpoint(self.ckpt_dir, self.state)
-            epoch_next = int(meta.get("epoch", -1)) + 1
+            found, epoch_next = 1, int(meta.get("epoch", -1)) + 1
         else:
-            state, epoch_next = self.state, 0
-        epoch_next = int(
-            multihost_utils.broadcast_one_to_all(np.int32(epoch_next))
+            state, found, epoch_next = self.state, 0, 0
+        # Separate found flag: a checkpoint with missing/epoch-less metadata
+        # must still restore its weights (resuming at epoch 0), matching the
+        # single-process branch.
+        found, epoch_next = (
+            int(v)
+            for v in multihost_utils.broadcast_one_to_all(
+                np.array([found, epoch_next], np.int32)
+            )
         )
-        if epoch_next > 0:
+        if found:
             state = multihost_utils.broadcast_one_to_all(state)
             self.state = jax.device_put(state, NamedSharding(self.mesh, P()))
             self.start_epoch = epoch_next

@@ -108,6 +108,17 @@ def test_checkpoint_prune_and_atomicity(tmp_path):
     assert not [f for f in os.listdir(d) if f.endswith(".tmp")]
 
 
+def test_prune_sweeps_orphan_metadata(tmp_path):
+    state = {"w": np.zeros(4, np.float32)}
+    d = str(tmp_path / "ck")
+    ckpt.save_checkpoint(d, state, step=1, keep=2)
+    # Simulate a crash between the json and blob renames of step 2.
+    open(os.path.join(d, "ckpt_2.json"), "w").write("{}")
+    ckpt.save_checkpoint(d, state, step=3, keep=2)
+    assert ckpt._steps(d) == [1, 3]
+    assert not os.path.exists(os.path.join(d, "ckpt_2.json"))
+
+
 def test_checkpoint_restore_missing_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         ckpt.restore_checkpoint(str(tmp_path / "none"), {"w": np.zeros(1)})
