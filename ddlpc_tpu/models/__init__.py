@@ -67,6 +67,7 @@ def _build_deeplab(cfg: ModelConfig, norm_axis_name: Optional[str]) -> nn.Module
 
     return DeepLabV3Plus(
         num_classes=cfg.num_classes,
+        features=tuple(cfg.features),
         width_divisor=cfg.width_divisor,
         output_stride=cfg.output_stride,
         aspp_rates=tuple(cfg.aspp_rates),

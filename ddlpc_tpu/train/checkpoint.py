@@ -29,6 +29,7 @@ from flax import serialization
 PyTree = Any
 
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.msgpack\.z$")
+_META_RE = re.compile(r"^ckpt_(\d+)\.json$")
 
 
 def _to_host(tree: PyTree) -> PyTree:
@@ -103,9 +104,9 @@ def _prune(ckpt_dir: str, keep: int) -> None:
     # Sweep metadata orphaned by a crash between the json and blob renames
     # (save order writes json first) — a .json with no blob is never a
     # restorable step and would otherwise accumulate forever.
-    alive = set(_steps(ckpt_dir))
+    alive = set(live[-keep:]) if keep > 0 else set(live)
     for name in os.listdir(ckpt_dir):
-        m = re.match(r"^ckpt_(\d+)\.json$", name)
+        m = _META_RE.match(name)
         if m and int(m.group(1)) not in alive:
             os.unlink(os.path.join(ckpt_dir, name))
 

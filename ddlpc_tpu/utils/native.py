@@ -1,0 +1,118 @@
+"""ctypes loader/builder for the native wire codec (csrc/wire.cc).
+
+The reference reached native code through the mgzip wheel (кластер.py:51,62);
+here the native component is part of the framework: a C++ block-parallel
+deflate codec with a C ABI.  ``load()`` finds a prebuilt ``libdwz.so`` (or
+builds it with g++ on first use) and returns a thin wrapper exposing
+``compress``/``decompress`` with the exact signature wire.py expects; any
+failure returns None and wire.py stays on its pure-Python zlib path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "csrc")
+_LIB = os.path.join(_CSRC, "libdwz.so")
+_MAX_THREADS = min(12, os.cpu_count() or 1)  # reference thread=12 (кластер.py:51)
+
+_lock = threading.Lock()
+_cached: Optional["NativeWire"] = None
+_failed = False
+
+
+class NativeWire:
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        lib.dwz_compress.restype = ctypes.c_int
+        lib.dwz_compress.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_int,
+            ctypes.c_size_t,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.dwz_decompress.restype = ctypes.c_int
+        lib.dwz_decompress.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.dwz_free.restype = None
+        lib.dwz_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+
+    def _take(self, out, out_len) -> bytes:
+        try:
+            return ctypes.string_at(out, out_len.value)
+        finally:
+            self._lib.dwz_free(out)
+
+    def compress(self, data: bytes, level: int, block_size: int) -> bytes:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_size_t()
+        rc = self._lib.dwz_compress(
+            data, len(data), level, block_size, _MAX_THREADS,
+            ctypes.byref(out), ctypes.byref(out_len),
+        )
+        if rc != 0:
+            raise RuntimeError(f"dwz_compress failed with code {rc}")
+        return self._take(out, out_len)
+
+    def decompress(self, data: bytes) -> bytes:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_size_t()
+        rc = self._lib.dwz_decompress(
+            data, len(data), _MAX_THREADS, ctypes.byref(out), ctypes.byref(out_len)
+        )
+        if rc == -5:
+            raise ValueError("bad wire magic; not a DWZ1 frame")
+        if rc == -6:
+            raise ValueError("truncated frame")
+        if rc == -7:
+            raise ValueError("trailing garbage in frame")
+        if rc != 0:
+            raise ValueError(f"corrupt frame (dwz_decompress code {rc})")
+        return self._take(out, out_len)
+
+
+def _build() -> bool:
+    if not os.path.exists(os.path.join(_CSRC, "wire.cc")):
+        return False
+    try:
+        subprocess.run(
+            ["make", "-s", "libdwz.so"],
+            cwd=_CSRC,
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return os.path.exists(_LIB)
+    except Exception:
+        return False
+
+
+def load(build: bool = True) -> Optional[NativeWire]:
+    """The loaded native codec, building it on first use; None on failure."""
+    global _cached, _failed
+    with _lock:
+        if _cached is not None:
+            return _cached
+        if _failed:
+            return None
+        if not os.path.exists(_LIB) and not (build and _build()):
+            _failed = True
+            return None
+        try:
+            _cached = NativeWire(ctypes.CDLL(_LIB))
+        except OSError:
+            _failed = True
+            return None
+        return _cached

@@ -83,11 +83,15 @@ def decompress(data: bytes) -> bytes:
     native = _get_native()
     if native is not None:
         return native.decompress(data)
+    if len(data) < 4:
+        raise ValueError("truncated frame: missing magic")
     if data[:4] != MAGIC:
         raise ValueError("bad wire magic; not a DWZ1 frame")
     if len(data) < 8:
         raise ValueError("truncated frame: missing block count")
     (nblk,) = struct.unpack_from("<I", data, 4)
+    if nblk > (len(data) - 8) // 8:
+        raise ValueError("truncated frame: block count exceeds frame size")
     off = 8
     metas = []
     for _ in range(nblk):

@@ -6,11 +6,26 @@ import os
 import numpy as np
 import pytest
 
-from ddlpc_tpu.utils import wire
+from ddlpc_tpu.utils import native, wire
+
+
+@pytest.fixture(params=["python", "native"])
+def backend(request, monkeypatch):
+    """Run every codec test against both the pure-Python path and the C++
+    library (csrc/wire.cc); the native param skips where g++/zlib aren't
+    available."""
+    if request.param == "python":
+        monkeypatch.setattr(wire, "_native", False)
+    else:
+        nw = native.load()
+        if nw is None:
+            pytest.skip("native codec not buildable here")
+        monkeypatch.setattr(wire, "_native", nw)
+    return request.param
 
 
 @pytest.mark.parametrize("size", [0, 1, 100, wire.BLOCK_SIZE, 3 * wire.BLOCK_SIZE + 17])
-def test_compress_roundtrip(size):
+def test_compress_roundtrip(size, backend):
     rng = np.random.default_rng(size)
     # Half-compressible payload: repeated pattern + noise.
     data = (b"segmentation" * (size // 24 + 1))[: size // 2]
@@ -18,28 +33,57 @@ def test_compress_roundtrip(size):
     assert wire.decompress(wire.compress(data)) == data
 
 
-def test_compress_actually_compresses():
+def test_compress_actually_compresses(backend):
     data = b"tile" * 100_000
     comp = wire.compress(data)
     assert len(comp) < len(data) // 10
 
 
-def test_decompress_rejects_bad_magic():
+def test_decompress_rejects_bad_magic(backend):
     with pytest.raises(ValueError, match="magic"):
         wire.decompress(b"NOPE" + b"\x00" * 16)
 
 
-def test_decompress_rejects_truncation_with_value_error():
+def test_decompress_rejects_truncation_with_value_error(backend):
     comp = wire.compress(b"hello world" * 1000)
-    for cut in (6, 10, len(comp) - 3):
+    for cut in (2, 6, 10, len(comp) - 3):
         with pytest.raises(ValueError, match="truncated"):
             wire.decompress(comp[:cut])
 
 
-def test_decompress_rejects_trailing_garbage():
+def test_decompress_rejects_huge_block_count(backend):
+    """An 8-byte corrupt frame claiming 2**32-1 blocks must raise, not
+    attempt a multi-GB allocation."""
+    import struct
+
+    frame = wire.MAGIC + struct.pack("<I", 0xFFFFFFFF)
+    with pytest.raises(ValueError, match="truncated"):
+        wire.decompress(frame)
+
+
+def test_decompress_rejects_trailing_garbage(backend):
     comp = wire.compress(b"hello") + b"extra"
     with pytest.raises(ValueError, match="trailing"):
         wire.decompress(comp)
+
+
+def test_python_native_interop():
+    """Both implementations speak the same DWZ1 frame, byte-compatibly."""
+    nw = native.load()
+    if nw is None:
+        pytest.skip("native codec not buildable here")
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 64, 3_000_000, dtype=np.uint8).tobytes()
+    # Force each side explicitly.
+    old = wire._native
+    try:
+        wire._native = False
+        py_frame = wire.compress(data)
+        assert nw.decompress(py_frame) == data
+        native_frame = nw.compress(data, wire.LEVEL, wire.BLOCK_SIZE)
+        assert wire.decompress(native_frame) == data
+    finally:
+        wire._native = old
 
 
 def test_message_framing_roundtrip():
