@@ -95,8 +95,11 @@ int dwz_compress(const uint8_t* data, size_t len, int level,
                  size_t* out_len) {
   if (!data && len) return -1;
   if (block_size == 0) block_size = 1 << 20;
+  // Frame fields are u32: refuse inputs that would truncate silently.
+  if (block_size > UINT32_MAX) return -2;
   size_t nblk = len ? (len + block_size - 1) / block_size : 0;
   if (nblk > UINT32_MAX) return -2;
+  if (compressBound(static_cast<uLong>(block_size)) > UINT32_MAX) return -2;
   std::vector<std::vector<uint8_t>> comp(nblk);
   std::atomic<bool> ok{true};
   parallel_for(nblk, max_threads > 0 ? max_threads : 1, [&](size_t i) {
@@ -143,12 +146,17 @@ int dwz_decompress(const uint8_t* data, size_t len, int max_threads,
   std::vector<size_t> comp_off(nblk), comp_len(nblk), raw_off(nblk),
       raw_len(nblk);
   size_t off = 8, total_raw = 0;
+  // Deflate cannot expand beyond ~1032:1; headers claiming more are forged.
+  // Checked per block BEFORE sizing the output, so a ~1 KB corrupt frame
+  // cannot drive a multi-GB allocation.
+  constexpr size_t kMaxInflateRatio = 1040;
   for (uint32_t i = 0; i < nblk; ++i) {
     if (off + 8 > len) return -6;
     raw_len[i] = get_u32(data + off);
     comp_len[i] = get_u32(data + off + 4);
     off += 8;
     if (off + comp_len[i] > len) return -6;
+    if (raw_len[i] > comp_len[i] * kMaxInflateRatio + 1024) return -3;
     comp_off[i] = off;
     off += comp_len[i];
     raw_off[i] = total_raw;

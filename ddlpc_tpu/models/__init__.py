@@ -92,8 +92,16 @@ def build_model(cfg: ModelConfig, norm_axis_name: Optional[str] = None) -> nn.Mo
 def build_model_from_experiment(ecfg) -> nn.Module:
     """Build honoring ParallelConfig.sync_batch_norm: per-batch cross-replica
     BN stat averaging over the data axis (the reference never re-syncs BN,
-    SURVEY §3.1)."""
+    SURVEY §3.1).
+
+    With a non-trivial space axis the GSPMD step is used
+    (parallel/train_step.py:make_train_step_gspmd), where BN statistics are
+    computed over the logical global batch — exact sync-BN without an axis
+    name — so ``norm_axis_name`` must stay None there.
+    """
     axis = (
-        ecfg.parallel.data_axis_name if ecfg.parallel.sync_batch_norm else None
+        ecfg.parallel.data_axis_name
+        if ecfg.parallel.sync_batch_norm and ecfg.parallel.space_axis_size <= 1
+        else None
     )
     return build_model(ecfg.model, norm_axis_name=axis)

@@ -1,0 +1,87 @@
+"""Halo exchange for spatially-sharded convolutions.
+
+The conv-segmentation analog of sequence/context parallelism: tiles too
+large for one chip's HBM are sharded along H across the mesh ``space`` axis,
+and each SAME-padded conv needs ``k//2`` boundary rows from the spatial
+neighbors.  The reference has nothing like this — its only axis is data
+parallelism over whole tiles (SURVEY §2 parallelism table); spatial sharding
+is how this framework scales the reference's "bigger tiles" dimension
+(кластер.py:737 fixes 512×512 because one GPU had to hold the whole tile).
+
+Two layers of support:
+
+- :func:`halo_exchange` — the explicit primitive for shard_map/Pallas code:
+  one bidirectional ``lax.ppermute`` ring shift per direction.  Devices at
+  the global edge receive zeros (ppermute's semantics for absent sources),
+  which composes exactly with SAME zero-padding.
+- The GSPMD path (parallel/train_step.py:make_train_step_gspmd) — for whole
+  models, XLA's SPMD partitioner inserts these halo exchanges automatically
+  for every conv when the input is sharded over ``space``; that is the
+  recommended way to train spatially-sharded (this module's primitive is for
+  hand-written kernels and for tests that pin down the semantics).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def halo_exchange(
+    x: jax.Array, axis_name: str, halo: int, spatial_axis: int = 1
+) -> jax.Array:
+    """Concatenate ``halo`` rows from each spatial neighbor onto this shard.
+
+    x: the local shard, e.g. [N, H_local, W, C] with ``spatial_axis=1``.
+    Returns [N, H_local + 2*halo, W, C]; the first/last shard's outer halo
+    is zeros (global-boundary SAME padding).  Call inside shard_map over
+    ``axis_name``.
+    """
+    if halo <= 0:
+        return x
+    n = lax.axis_size(axis_name)
+    if x.shape[spatial_axis] < halo:
+        raise ValueError(
+            f"local spatial extent {x.shape[spatial_axis]} smaller than halo "
+            f"{halo}; use fewer shards or larger tiles"
+        )
+
+    def take(start: bool, count: int) -> jax.Array:
+        idx = [slice(None)] * x.ndim
+        idx[spatial_axis] = slice(0, count) if start else slice(-count, None)
+        return x[tuple(idx)]
+
+    # Shard i sends its TOP rows to i-1 (their bottom halo) and its BOTTOM
+    # rows to i+1 (their top halo).  Devices with no source receive zeros.
+    to_prev = [(i, i - 1) for i in range(1, n)]
+    to_next = [(i, i + 1) for i in range(n - 1)]
+    from_next = lax.ppermute(take(True, halo), axis_name, to_prev)
+    from_prev = lax.ppermute(take(False, halo), axis_name, to_next)
+    return jax.numpy.concatenate([from_prev, x, from_next], axis=spatial_axis)
+
+
+def sharded_same_conv(
+    x: jax.Array,
+    kernel: jax.Array,
+    axis_name: str,
+    spatial_axis: int = 1,
+) -> jax.Array:
+    """SAME conv over an H-sharded NHWC input: halo-exchange then slice.
+
+    Reference semantics check for the primitive: inside shard_map over
+    ``axis_name`` this equals the unsharded ``lax.conv_general_dilated``
+    with SAME padding on the concatenated global array (tests/test_halo.py).
+    kernel: [kh, kw, C_in, C_out], odd kh.
+    """
+    kh = kernel.shape[0]
+    halo = kh // 2
+    padded = halo_exchange(x, axis_name, halo, spatial_axis)
+    # H got VALID-cropped by the conv exactly where the halo was added; W
+    # keeps SAME padding.
+    return lax.conv_general_dilated(
+        padded,
+        kernel,
+        window_strides=(1, 1),
+        padding=((0, 0), (kernel.shape[1] // 2,) * 2),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )

@@ -113,10 +113,10 @@ def make_train_step(
     """
     for name, size in mesh.shape.items():
         if name != data_axis and size > 1:
-            raise NotImplementedError(
-                f"mesh axis {name!r} (size {size}) is not yet consumed by the "
-                f"train step — spatial halo sharding lands in parallel/halo.py; "
-                f"until then use a pure data mesh"
+            raise ValueError(
+                f"mesh axis {name!r} (size {size}) is not consumed by the "
+                f"shard_map train step — use make_train_step_gspmd for "
+                f"data×space meshes (the Trainer selects it automatically)"
             )
 
     def shard_body(state: TrainState, images: jax.Array, labels: jax.Array):
@@ -171,6 +171,109 @@ def make_train_step(
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate_state else ())
+
+
+def make_train_step_gspmd(
+    model: nn.Module,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    compression: CompressionConfig,
+    data_axis: str = "data",
+    space_axis: Optional[str] = "space",
+    donate_state: bool = True,
+) -> Callable[[TrainState, jax.Array, jax.Array], Tuple[TrainState, dict]]:
+    """GSPMD train step: batch sharded over ``data`` AND H over ``space``.
+
+    Where the shard_map path writes the collectives by hand, here the
+    program is expressed over *global* arrays and XLA's SPMD partitioner
+    inserts everything: the gradient all-reduce over ``data``, and — the
+    point of this path — per-conv halo exchanges over ``space`` for
+    H-sharded tiles (see parallel/halo.py for the hand-written equivalent).
+    This is how the framework trains tiles too large for one chip's HBM,
+    the spatial analog of sequence/context parallelism.
+
+    Differences vs the shard_map path, by construction:
+    - BatchNorm must be built WITHOUT ``norm_axis_name``: batch statistics
+      are computed over the logical global batch, which the partitioner
+      turns into exact cross-replica sync-BN on its own.
+    - The codec's ``quantize_local`` stage (per-replica quantization before
+      the reduce, кластер.py:450-496) has no meaning here — there is no
+      per-replica gradient in the program; only ``quantize_mean``
+      (кластер.py:328-396) applies.  The shard_map path remains the
+      reference-parity codec path.
+    """
+
+    def step_fn(state: TrainState, images: jax.Array, labels: jax.Array):
+        def micro(carry, xy):
+            grads_acc, stats = carry
+            x, y = xy
+            (loss, (stats, acc)), grads = jax.value_and_grad(
+                lambda p: _loss_and_metrics(model, p, stats, x, y, train=True),
+                has_aux=True,
+            )(state.params)
+            grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+            return (grads_acc, stats), (loss, acc)
+
+        zeros = jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), state.params)
+        (grads, batch_stats), (losses, accs) = lax.scan(
+            micro, (zeros, state.batch_stats), (images, labels)
+        )
+        grads = jax.tree.map(lambda g: g / images.shape[0], grads)
+        if compression.mode != "none" and compression.quantize_mean:
+            from ddlpc_tpu.ops.quantize import fake_quantize
+
+            grads = fake_quantize(grads, compression)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "loss": losses.mean(),
+            "pixel_acc": accs.mean(),
+            "grad_norm": optax.global_norm(grads),
+        }
+        new_state = TrainState(
+            step=state.step + 1,
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=opt_state,
+        )
+        return new_state, metrics
+
+    repl = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, P(None, data_axis, space_axis))
+    return jax.jit(
+        step_fn,
+        in_shardings=(repl, batch_sh, batch_sh),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,) if donate_state else (),
+    )
+
+
+def make_eval_step_gspmd(
+    model: nn.Module,
+    mesh: Mesh,
+    num_classes: int,
+    data_axis: str = "data",
+    space_axis: Optional[str] = "space",
+) -> Callable[[TrainState, jax.Array, jax.Array], dict]:
+    """GSPMD eval: batch [B,H,W,C] sharded over (data, space)."""
+
+    def eval_fn(state: TrainState, images: jax.Array, labels: jax.Array):
+        logits = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            images,
+            train=False,
+        )
+        cm = confusion_from_logits(logits, labels, num_classes)
+        nll_sum, count = softmax_cross_entropy_sum(logits, labels, ignore_index=-1)
+        return {"confusion": cm, "loss_sum": nll_sum, "pixel_count": count}
+
+    repl = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, P(data_axis, space_axis))
+    return jax.jit(
+        eval_fn,
+        in_shardings=(repl, batch_sh, batch_sh),
+        out_shardings=repl,
+    )
 
 
 def make_eval_step(

@@ -30,8 +30,10 @@ from ddlpc_tpu.parallel.mesh import initialize_distributed, make_mesh
 from ddlpc_tpu.parallel.train_step import (
     create_train_state,
     make_eval_step,
+    make_eval_step_gspmd,
     make_predict_fn,
     make_train_step,
+    make_train_step_gspmd,
 )
 from ddlpc_tpu.train import checkpoint as ckpt
 from ddlpc_tpu.train.observability import (
@@ -78,19 +80,41 @@ class Trainer:
         )
         self.state = jax.device_put(self.state, NamedSharding(self.mesh, P()))
 
-        self.train_step = make_train_step(
-            self.model,
-            self.tx,
-            self.mesh,
-            cfg.compression,
-            data_axis=cfg.parallel.data_axis_name,
-        )
-        self.eval_step = make_eval_step(
-            self.model,
-            self.mesh,
-            num_classes=cfg.model.num_classes,
-            data_axis=cfg.parallel.data_axis_name,
-        )
+        # Pure data mesh → hand-written shard_map collectives (reference-
+        # parity codec semantics); data×space mesh → GSPMD, where XLA
+        # partitions convs along H with automatic halo exchange.
+        self.spatial = cfg.parallel.space_axis_size > 1
+        space = cfg.parallel.space_axis_name if self.spatial else None
+        if self.spatial:
+            self.train_step = make_train_step_gspmd(
+                self.model,
+                self.tx,
+                self.mesh,
+                cfg.compression,
+                data_axis=cfg.parallel.data_axis_name,
+                space_axis=space,
+            )
+            self.eval_step = make_eval_step_gspmd(
+                self.model,
+                self.mesh,
+                num_classes=cfg.model.num_classes,
+                data_axis=cfg.parallel.data_axis_name,
+                space_axis=space,
+            )
+        else:
+            self.train_step = make_train_step(
+                self.model,
+                self.tx,
+                self.mesh,
+                cfg.compression,
+                data_axis=cfg.parallel.data_axis_name,
+            )
+            self.eval_step = make_eval_step(
+                self.model,
+                self.mesh,
+                num_classes=cfg.model.num_classes,
+                data_axis=cfg.parallel.data_axis_name,
+            )
         self.predict = make_predict_fn(self.model)
 
         self.loader = ShardedLoader(
@@ -101,6 +125,7 @@ class Trainer:
             shuffle=cfg.data.shuffle,
             seed=cfg.data.seed,
             data_axis=cfg.parallel.data_axis_name,
+            space_axis=space,
         )
 
         self.workdir = cfg.workdir
@@ -189,6 +214,7 @@ class Trainer:
             self.mesh,
             global_batch=self.global_micro_batch,
             data_axis=self.cfg.parallel.data_axis_name,
+            space_axis=self.cfg.parallel.space_axis_name if self.spatial else None,
         ):
             out = self.eval_step(self.state, images, labels)
             cm += np.asarray(out["confusion"], np.float64)

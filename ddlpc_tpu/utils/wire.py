@@ -101,6 +101,12 @@ def decompress(data: bytes) -> bytes:
         off += 8
         if off + comp_len > len(data):
             raise ValueError("truncated frame: missing block payload")
+        # Deflate cannot expand beyond ~1032:1; a header claiming more is
+        # forged (mirrors the C++ decoder's bound).
+        if raw_len > comp_len * 1040 + 1024:
+            raise ValueError(
+                f"corrupt frame: block claims {raw_len} bytes from {comp_len}"
+            )
         metas.append((raw_len, data[off : off + comp_len]))
         off += comp_len
     if off != len(data):
@@ -108,9 +114,16 @@ def decompress(data: bytes) -> bytes:
 
     def one(meta):
         raw_len, comp = meta
-        raw = zlib.decompress(comp)
-        if len(raw) != raw_len:
-            raise ValueError(f"block decompressed to {len(raw)}, header says {raw_len}")
+        # Cap the inflate at the header's claimed size (+1 to detect excess)
+        # so a deflate-bomb block cannot allocate more than the header
+        # admits to — the header itself is bounded against the frame above.
+        d = zlib.decompressobj()
+        raw = d.decompress(comp, raw_len + 1)
+        if len(raw) != raw_len or not d.eof or d.unused_data:
+            raise ValueError(
+                f"block decompressed to {len(raw)}{'+' if not d.eof else ''}, "
+                f"header says {raw_len}"
+            )
         return raw
 
     if nblk <= 1:

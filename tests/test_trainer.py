@@ -26,7 +26,7 @@ def tiny_config(workdir: str, **train_kw) -> ExperimentConfig:
             features=(8, 16), bottleneck_features=16, num_classes=4
         ),
         data=DataConfig(
-            image_size=(32, 32), synthetic_len=40, test_split=8, num_classes=4
+            dataset="synthetic", image_size=(32, 32), synthetic_len=40, test_split=8, num_classes=4
         ),
         train=TrainConfig(
             epochs=2,

@@ -67,6 +67,40 @@ def test_decompress_rejects_trailing_garbage(backend):
         wire.decompress(comp)
 
 
+def test_decompress_rejects_forged_raw_len(backend):
+    """A header claiming an implausible expansion (beyond deflate's ~1032:1
+    ceiling) must be rejected before any allocation happens."""
+    import struct
+    import zlib as _zlib
+
+    comp = _zlib.compress(b"x", 1)
+    frame = (
+        wire.MAGIC
+        + struct.pack("<I", 1)
+        + struct.pack("<II", 0xFFFFFFFF, len(comp))
+        + comp
+    )
+    with pytest.raises(ValueError, match="corrupt|claims"):
+        wire.decompress(frame)
+
+
+def test_decompress_rejects_wrong_block_length(backend):
+    """A block whose actual inflated size disagrees with its header raises."""
+    import struct
+    import zlib as _zlib
+
+    payload = b"y" * 100
+    comp = _zlib.compress(payload, 1)
+    frame = (
+        wire.MAGIC
+        + struct.pack("<I", 1)
+        + struct.pack("<II", 50, len(comp))  # header lies: 50 != 100
+        + comp
+    )
+    with pytest.raises(ValueError):
+        wire.decompress(frame)
+
+
 def test_python_native_interop():
     """Both implementations speak the same DWZ1 frame, byte-compatibly."""
     nw = native.load()
