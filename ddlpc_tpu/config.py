@@ -81,6 +81,10 @@ class TrainConfig:
     keep_checkpoints: int = 3
     eval_every_epochs: int = 1
     dump_images_per_epoch: int = 5  # qualitative PNG triples (кластер.py:785-790)
+    # Epoch index to capture an XLA profiler trace for (into
+    # <workdir>/profile); -1 disables.  Replaces the reference's wall-clock
+    # print "tracing" (SURVEY §5).
+    profile_epoch: int = -1
 
 
 @dataclass(frozen=True)

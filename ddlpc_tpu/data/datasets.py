@@ -60,6 +60,37 @@ class TileDataset:
         return tuple(self.images.shape[1:])  # type: ignore[return-value]
 
 
+def load_image_file(
+    path: str,
+    image_size: Tuple[int, int],
+    channels: int = 3,
+    normalize: bool = True,
+) -> np.ndarray:
+    """One image file → [H, W, channels] float array at exactly
+    ``image_size``: crops larger inputs (the reference's ``[:512,:512]``,
+    кластер.py:822), zero-pads smaller ones, repeats grayscale / drops alpha
+    to reach ``channels``.  Shared by the dataset reader and the predict CLI
+    so their preprocessing cannot drift."""
+    import imageio.v2 as imageio
+
+    img = np.asarray(imageio.imread(path))
+    if img.ndim == 2:
+        img = img[..., None]
+    if img.shape[-1] < channels:
+        img = np.repeat(img[..., :1], channels, axis=-1)
+    elif img.shape[-1] > channels:
+        img = img[..., :channels]
+    h, w = image_size
+    img = img[:h, :w]
+    if img.shape[0] < h or img.shape[1] < w:
+        pad = ((0, h - img.shape[0]), (0, w - img.shape[1]), (0, 0))
+        img = np.pad(img, pad)
+    img = img.astype(np.float32)
+    if normalize:
+        img /= 255.0  # кластер.py:737
+    return img
+
+
 def load_tile_dir(
     path: str,
     image_size: Optional[Tuple[int, int]] = None,
@@ -114,19 +145,16 @@ def load_tile_dir(
         )
     images, labels = [], []
     for img_f, npy_f in zip(img_files, npy_files):
-        img = np.asarray(imageio.imread(img_f))
         lab = np.load(npy_f)
-        if image_size is not None:
-            h, w = image_size
-            img, lab = img[:h, :w], lab[:h, :w]
-        images.append(img)
+        size = tuple(image_size) if image_size is not None else lab.shape[:2]
+        images.append(load_image_file(img_f, size, normalize=normalize))
+        lab = lab[: size[0], : size[1]]
+        if lab.shape != size:
+            lab = np.pad(
+                lab, ((0, size[0] - lab.shape[0]), (0, size[1] - lab.shape[1]))
+            )
         labels.append(lab)
-    x = np.stack(images).astype(np.float32)
-    if normalize:
-        x /= 255.0  # кластер.py:737
-    if x.ndim == 3:
-        x = x[..., None]
-    return TileDataset(x, np.stack(labels).astype(np.int32))
+    return TileDataset(np.stack(images), np.stack(labels).astype(np.int32))
 
 
 def train_test_split(

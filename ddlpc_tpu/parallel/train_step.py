@@ -94,6 +94,35 @@ def _loss_and_metrics(
     return loss, (new_stats, acc)
 
 
+def _accumulate_grads(
+    model: nn.Module,
+    state: "TrainState",
+    images: jax.Array,
+    labels: jax.Array,
+):
+    """Scan ``A`` micro-batches accumulating fp32 grads (the reference's
+    loss.backward() accumulation loop, кластер.py:750-759).  Shared by the
+    shard_map and GSPMD step builders so their semantics cannot diverge.
+    Returns (mean grads, new batch_stats, losses [A], accs [A])."""
+
+    def micro(carry, xy):
+        grads_acc, stats = carry
+        x, y = xy
+        (loss, (stats, acc)), grads = jax.value_and_grad(
+            lambda p: _loss_and_metrics(model, p, stats, x, y, train=True),
+            has_aux=True,
+        )(state.params)
+        grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+        return (grads_acc, stats), (loss, acc)
+
+    zeros = jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), state.params)
+    (grads, batch_stats), (losses, accs) = lax.scan(
+        micro, (zeros, state.batch_stats), (images, labels)
+    )
+    grads = jax.tree.map(lambda g: g / images.shape[0], grads)
+    return grads, batch_stats, losses, accs
+
+
 def make_train_step(
     model: nn.Module,
     tx: optax.GradientTransformation,
@@ -121,22 +150,9 @@ def make_train_step(
 
     def shard_body(state: TrainState, images: jax.Array, labels: jax.Array):
         # Inside shard_map: images [A, B_local, H, W, C].
-        def micro(carry, xy):
-            grads_acc, stats = carry
-            x, y = xy
-            (loss, (stats, acc)), grads = jax.value_and_grad(
-                lambda p: _loss_and_metrics(model, p, stats, x, y, train=True),
-                has_aux=True,
-            )(state.params)
-            grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
-            return (grads_acc, stats), (loss, acc)
-
-        zeros = jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), state.params)
-        (grads, batch_stats), (losses, accs) = lax.scan(
-            micro, (zeros, state.batch_stats), (images, labels)
+        grads, batch_stats, losses, accs = _accumulate_grads(
+            model, state, images, labels
         )
-        num_accum = images.shape[0]
-        grads = jax.tree.map(lambda g: g / num_accum, grads)
         # Keep BatchNorm running stats replica-identical at every sync point:
         # with per-batch sync-BN (norm_axis_name set) this pmean is a no-op;
         # without it, it averages the per-replica running stats — either way
@@ -203,23 +219,19 @@ def make_train_step_gspmd(
       reference-parity codec path.
     """
 
-    def step_fn(state: TrainState, images: jax.Array, labels: jax.Array):
-        def micro(carry, xy):
-            grads_acc, stats = carry
-            x, y = xy
-            (loss, (stats, acc)), grads = jax.value_and_grad(
-                lambda p: _loss_and_metrics(model, p, stats, x, y, train=True),
-                has_aux=True,
-            )(state.params)
-            grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
-            return (grads_acc, stats), (loss, acc)
-
-        zeros = jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), state.params)
-        (grads, batch_stats), (losses, accs) = lax.scan(
-            micro, (zeros, state.batch_stats), (images, labels)
+    if compression.mode != "none" and not compression.quantize_mean:
+        raise ValueError(
+            "the GSPMD step cannot represent quantize_local-only compression "
+            "(there is no per-replica gradient in the program): set "
+            "compression.quantize_mean=True, or mode='none', or use a pure "
+            "data mesh for reference-parity codec semantics"
         )
-        grads = jax.tree.map(lambda g: g / images.shape[0], grads)
-        if compression.mode != "none" and compression.quantize_mean:
+
+    def step_fn(state: TrainState, images: jax.Array, labels: jax.Array):
+        grads, batch_stats, losses, accs = _accumulate_grads(
+            model, state, images, labels
+        )
+        if compression.mode != "none":
             from ddlpc_tpu.ops.quantize import fake_quantize
 
             grads = fake_quantize(grads, compression)

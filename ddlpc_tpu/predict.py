@@ -1,0 +1,84 @@
+"""Inference CLI: ``python -m ddlpc_tpu.predict --workdir runs/x --input dir``.
+
+The reference has no inference path at all — its closest artifact is the
+in-training PNG dump (кластер.py:785-790).  This restores a trained
+checkpoint and writes a color-mapped class-map PNG per input image.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m ddlpc_tpu.predict")
+    p.add_argument("--workdir", required=True, help="training run directory")
+    p.add_argument("--input", required=True, help="directory of images")
+    p.add_argument("--output", help="output directory (default <workdir>/predictions)")
+    p.add_argument("--batch", type=int, default=8)
+    args = p.parse_args(argv)
+
+    import jax
+    from PIL import Image
+
+    from ddlpc_tpu.config import ExperimentConfig
+    from ddlpc_tpu.models import build_model
+    from ddlpc_tpu.parallel.train_step import create_train_state, make_predict_fn
+    from ddlpc_tpu.train import checkpoint as ckpt
+    from ddlpc_tpu.train.observability import class_palette
+    from ddlpc_tpu.train.optim import build_optimizer
+
+    with open(os.path.join(args.workdir, "config.json")) as f:
+        cfg = ExperimentConfig.from_json(f.read())
+    # Inference is single-device: no mesh axis for BN stats.
+    model = build_model(cfg.model, norm_axis_name=None)
+    tx = build_optimizer(cfg.train)
+    h, w = cfg.data.image_size
+    state = create_train_state(model, tx, jax.random.key(0), (1, h, w, 3))
+    state, meta = ckpt.restore_checkpoint(
+        os.path.join(args.workdir, "checkpoints"), state
+    )
+    print(f"restored step {meta.get('step')} (epoch {meta.get('epoch')})")
+    predict = make_predict_fn(model)
+
+    out_dir = args.output or os.path.join(args.workdir, "predictions")
+    os.makedirs(out_dir, exist_ok=True)
+    pal = class_palette(cfg.model.num_classes)
+
+    from ddlpc_tpu.data.datasets import load_image_file
+
+    names = sorted(
+        n
+        for n in os.listdir(args.input)
+        if not n.endswith(".npy") and os.path.isfile(os.path.join(args.input, n))
+    )
+    if not names:
+        print(f"no images found in {args.input}", file=sys.stderr)
+        return 1
+    for start in range(0, len(names), args.batch):
+        chunk = names[start : start + args.batch]
+        batch = np.stack(
+            [load_image_file(os.path.join(args.input, n), (h, w)) for n in chunk]
+        )
+        # Pad the tail to the compiled batch size.
+        valid = len(chunk)
+        if valid < args.batch:
+            batch = np.concatenate(
+                [batch, np.repeat(batch[-1:], args.batch - valid, axis=0)]
+            )
+        preds = np.asarray(predict(state, batch))[:valid]
+        for n, pred in zip(chunk, preds):
+            stem = n.rsplit(".", 1)[0]
+            Image.fromarray(pal[np.clip(pred, 0, cfg.model.num_classes - 1)]).save(
+                os.path.join(out_dir, f"{stem}_pred.png")
+            )
+    print(f"wrote {len(names)} predictions to {out_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -116,6 +116,36 @@ class StageTimer:
         self.counts.clear()
 
 
+@contextmanager
+def maybe_profile(trace_dir: Optional[str], enabled: bool = True):
+    """XLA/TPU profiler trace around a block (view with TensorBoard or
+    xprof).  The reference's only tracing is scattered wall-clock prints
+    (SURVEY §5); this wraps ``jax.profiler.trace`` so one config flag
+    captures real device timelines.  No-op when disabled or trace_dir is
+    None; never fails the run if the profiler is unavailable."""
+    if not enabled or not trace_dir or jax.process_index() != 0:
+        yield
+        return
+    # Guard only the profiler's own enter/exit — an exception raised by the
+    # profiled body must propagate untouched.
+    import warnings
+
+    ctx = jax.profiler.trace(trace_dir)
+    try:
+        ctx.__enter__()
+    except Exception as e:  # profiler may be unsupported on a backend
+        warnings.warn(f"profiler trace failed to start: {e}", stacklevel=2)
+        yield
+        return
+    try:
+        yield
+    finally:
+        try:
+            ctx.__exit__(None, None, None)
+        except Exception as e:
+            warnings.warn(f"profiler trace failed to stop: {e}", stacklevel=2)
+
+
 def dump_prediction_triples(
     workdir: str,
     images: np.ndarray,

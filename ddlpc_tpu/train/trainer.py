@@ -40,6 +40,7 @@ from ddlpc_tpu.train.observability import (
     MetricsLogger,
     StageTimer,
     dump_prediction_triples,
+    maybe_profile,
 )
 from ddlpc_tpu.train.optim import build_optimizer
 
@@ -180,9 +181,19 @@ class Trainer:
         self.loader.set_epoch(epoch)
         losses, accs = [], []
         t_epoch = time.perf_counter()
-        for images, labels in self.loader:
+        it = iter(self.loader)
+        while True:
+            # Stage-resolved timing: the structured version of the
+            # reference's per-stage time.time() prints (кластер.py:265-440).
+            # "data" = host wait for the next uploaded super-batch (overlaps
+            # compute via the loader's prefetch); "step" = compiled SPMD
+            # step dispatch.
+            with self.timer.stage("data"):
+                batch = next(it, None)
+            if batch is None:
+                break
             with self.timer.stage("step"):
-                self.state, metrics = self.train_step(self.state, images, labels)
+                self.state, metrics = self.train_step(self.state, *batch)
             losses.append(metrics["loss"])
             accs.append(metrics["pixel_acc"])
         # One host sync per epoch (metrics stayed on device inside the loop).
@@ -190,7 +201,7 @@ class Trainer:
         accs = [float(a) for a in accs]
         epoch_time = time.perf_counter() - t_epoch
         steps = max(len(losses), 1)
-        return {
+        record = {
             "epoch": epoch,
             "loss": float(np.mean(losses)) if losses else float("nan"),
             "pixel_acc": float(np.mean(accs)) if accs else float("nan"),
@@ -200,6 +211,11 @@ class Trainer:
             "step_time_s": epoch_time / steps,
             "tiles_per_s": len(self.loader) * self.loader.super_batch / epoch_time,
         }
+        record.update(
+            {f"t_{name}_s": t for name, t in self.timer.means().items()}
+        )
+        self.timer.reset()
+        return record
 
     def evaluate(self) -> Dict[str, float]:
         """Held-out mIoU/accuracy/loss — the metric path the reference lacks
@@ -258,7 +274,11 @@ class Trainer:
         epochs = epochs if epochs is not None else cfg.epochs
         record: Dict[str, float] = {}
         for epoch in range(self.start_epoch, epochs):
-            record = self.train_epoch(epoch)
+            with maybe_profile(
+                os.path.join(self.workdir, "profile"),
+                enabled=epoch == cfg.profile_epoch,
+            ):
+                record = self.train_epoch(epoch)
             if cfg.eval_every_epochs and (epoch + 1) % cfg.eval_every_epochs == 0:
                 record.update(self.evaluate())
             self.logger.log(record)

@@ -152,6 +152,45 @@ def test_metrics_logger_types(tmp_path):
     assert rec["loss"] == 0.5 and rec["epoch"] == 1 and "time" in rec
 
 
+def test_predict_cli(run, tmp_path):
+    import imageio.v2 as imageio
+
+    from ddlpc_tpu.predict import main as predict_main
+
+    workdir, _, _ = run
+    in_dir = tmp_path / "imgs"
+    in_dir.mkdir()
+    rng = np.random.default_rng(0)
+    for i in range(3):
+        imageio.imwrite(
+            in_dir / f"t{i}.png",
+            rng.integers(0, 255, (32, 32, 3), dtype=np.uint8),
+        )
+    out_dir = tmp_path / "preds"
+    assert predict_main(
+        ["--workdir", workdir, "--input", str(in_dir), "--output", str(out_dir),
+         "--batch", "2"]
+    ) == 0
+    outs = sorted(os.listdir(out_dir))
+    assert outs == ["t0_pred.png", "t1_pred.png", "t2_pred.png"]
+    img = imageio.imread(out_dir / "t0_pred.png")
+    assert img.shape == (32, 32, 3)
+
+
+def test_configs_dir_parses():
+    """The shipped BASELINE config artifacts must round-trip through the
+    config system."""
+    import glob
+
+    from ddlpc_tpu.config import ExperimentConfig
+
+    paths = sorted(glob.glob(os.path.join(os.path.dirname(__file__), "..", "configs", "*.json")))
+    assert len(paths) == 5
+    for p in paths:
+        cfg = ExperimentConfig.from_json(open(p).read())
+        assert cfg.model.num_classes == cfg.data.num_classes
+
+
 def test_cli_overrides(tmp_path):
     from ddlpc_tpu.train.__main__ import parse_config
 
