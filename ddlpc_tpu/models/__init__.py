@@ -40,6 +40,8 @@ def _build_unet(cfg: ModelConfig, norm_axis_name: Optional[str]) -> nn.Module:
         norm=cfg.norm,
         norm_axis_name=norm_axis_name,
         norm_groups=cfg.group_norm_groups,
+        stem=cfg.stem,
+        stem_factor=cfg.stem_factor,
         dtype=jnp.dtype(cfg.compute_dtype),
     )
 

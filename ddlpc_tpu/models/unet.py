@@ -17,7 +17,13 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from ddlpc_tpu.models.layers import DoubleConv, DownBlock, UpBlock
+from ddlpc_tpu.models.layers import (
+    DoubleConv,
+    DownBlock,
+    UpBlock,
+    depth_to_space,
+    space_to_depth,
+)
 
 
 class UNet(nn.Module):
@@ -29,6 +35,8 @@ class UNet(nn.Module):
     norm: str = "batch"
     norm_axis_name: Optional[str] = None
     norm_groups: int = 8
+    stem: str = "none"  # none | s2d (see ModelConfig.stem)
+    stem_factor: int = 2
     dtype: Any = jnp.bfloat16
 
     def _w(self, f: int) -> int:
@@ -38,6 +46,12 @@ class UNet(nn.Module):
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
         """x: [N, H, W, C] float; returns logits [N, H, W, num_classes] float32."""
         x = x.astype(self.dtype)
+        if self.stem == "s2d":
+            # Run the whole pyramid at 1/r resolution on r²-richer channels;
+            # logits come back to full resolution through a subpixel head.
+            x = space_to_depth(x, self.stem_factor)
+        elif self.stem != "none":
+            raise ValueError(f"unknown stem {self.stem!r}")
         common = dict(
             norm=self.norm,
             norm_axis_name=self.norm_axis_name,
@@ -53,10 +67,15 @@ class UNet(nn.Module):
             x = UpBlock(self._w(f), up_sample_mode=self.up_sample_mode, **common)(
                 x, skip, train
             )
+        head_classes = self.num_classes
+        if self.stem == "s2d":
+            head_classes *= self.stem_factor**2
         logits = nn.Conv(
-            self.num_classes,
+            head_classes,
             (1, 1),
             dtype=jnp.float32,
             param_dtype=jnp.float32,
         )(x.astype(jnp.float32))
+        if self.stem == "s2d":
+            logits = depth_to_space(logits, self.stem_factor)
         return logits
