@@ -16,15 +16,20 @@ import jax.numpy as jnp
 def _per_pixel_nll(
     logits: jax.Array, labels: jax.Array, label_smoothing: float = 0.0
 ) -> jax.Array:
+    # One-hot select instead of take_along_axis: a per-pixel gather lowers to
+    # a serialized custom kernel on TPU (profiled at ~128 ms per micro-batch
+    # for [32,512,512,6] — half the train step), while compare+select+reduce
+    # fuses into the surrounding elementwise work.  logsumexp instead of
+    # log_softmax avoids materializing an fp32 [..., C] log-prob tensor.
     logits = logits.astype(jnp.float32)
     num_classes = logits.shape[-1]
-    log_probs = jax.nn.log_softmax(logits, axis=-1)
-    labels_clipped = jnp.clip(labels, 0, num_classes - 1)
-    nll = -jnp.take_along_axis(
-        log_probs, labels_clipped[..., None].astype(jnp.int32), axis=-1
-    )[..., 0]
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    labels_clipped = jnp.clip(labels, 0, num_classes - 1).astype(jnp.int32)
+    onehot = labels_clipped[..., None] == jnp.arange(num_classes, dtype=jnp.int32)
+    picked = jnp.sum(jnp.where(onehot, logits, 0.0), axis=-1)
+    nll = lse - picked
     if label_smoothing > 0.0:
-        smooth = -log_probs.mean(axis=-1)
+        smooth = lse - logits.mean(axis=-1)
         nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
     return nll
 
