@@ -63,6 +63,13 @@ class DataConfig:
     shuffle: bool = True  # reference computes a shuffle but never applies it (кластер.py:722-723)
     synthetic_len: int = 127  # reference trains on 127 tiles (кластер.py:720)
     seed: int = 0
+    # > 0 switches to random-crop scene mode: the data_dir is read at native
+    # scene size and each epoch draws this many image_size crops (the
+    # many-crop generalization of the reference's fixed [:512,:512] crop,
+    # кластер.py:817-823).  Evaluation uses a deterministic grid tiling of
+    # the held-out scenes (capped at ``test_split`` tiles).
+    crops_per_epoch: int = 0
+    test_split_scenes: int = 1  # scenes held out for eval in crop mode
 
 
 @dataclass(frozen=True)

@@ -8,10 +8,13 @@ processes and mesh replicas, with a synthetic generator for tests/benchmarks.
 """
 
 from ddlpc_tpu.data.datasets import (  # noqa: F401
+    CropDataset,
     SyntheticTiles,
     TileDataset,
     build_dataset,
     dataset_defaults,
+    grid_tiles,
+    load_scene_dir,
     train_test_split,
 )
 from ddlpc_tpu.data.loader import ShardedLoader, make_global_array  # noqa: F401

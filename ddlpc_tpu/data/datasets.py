@@ -55,6 +55,17 @@ class TileDataset:
     def __getitem__(self, idx) -> Tuple[np.ndarray, np.ndarray]:
         return self.images[idx], self.labels[idx]
 
+    def gather(self, indices: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Materialize (images [n,H,W,C], labels [n,H,W]) for an index array.
+
+        The loader's only data access point — crop-sampling datasets
+        (:class:`CropDataset`) override it to materialize tiles on demand.
+        """
+        return self.images[indices], self.labels[indices]
+
+    def set_epoch(self, epoch: int) -> None:
+        """Hook for epoch-dependent sampling (no-op for fixed tiles)."""
+
     @property
     def image_shape(self) -> Tuple[int, int, int]:
         return tuple(self.images.shape[1:])  # type: ignore[return-value]
@@ -62,15 +73,17 @@ class TileDataset:
 
 def load_image_file(
     path: str,
-    image_size: Tuple[int, int],
+    image_size: Optional[Tuple[int, int]],
     channels: int = 3,
     normalize: bool = True,
 ) -> np.ndarray:
-    """One image file → [H, W, channels] float array at exactly
-    ``image_size``: crops larger inputs (the reference's ``[:512,:512]``,
-    кластер.py:822), zero-pads smaller ones, repeats grayscale / drops alpha
-    to reach ``channels``.  Shared by the dataset reader and the predict CLI
-    so their preprocessing cannot drift."""
+    """One image file → [H, W, channels] float array.
+
+    ``image_size`` set: crops larger inputs (the reference's ``[:512,:512]``,
+    кластер.py:822) and zero-pads smaller ones to exactly that size;
+    ``image_size=None``: native size.  Repeats grayscale / drops alpha to
+    reach ``channels``.  Shared by the tile reader, the scene reader, and
+    the predict CLI so their preprocessing cannot drift."""
     import imageio.v2 as imageio
 
     img = np.asarray(imageio.imread(path))
@@ -80,15 +93,206 @@ def load_image_file(
         img = np.repeat(img[..., :1], channels, axis=-1)
     elif img.shape[-1] > channels:
         img = img[..., :channels]
-    h, w = image_size
-    img = img[:h, :w]
-    if img.shape[0] < h or img.shape[1] < w:
-        pad = ((0, h - img.shape[0]), (0, w - img.shape[1]), (0, 0))
-        img = np.pad(img, pad)
+    if image_size is not None:
+        h, w = image_size
+        img = img[:h, :w]
+        if img.shape[0] < h or img.shape[1] < w:
+            pad = ((0, h - img.shape[0]), (0, w - img.shape[1]), (0, 0))
+            img = np.pad(img, pad)
     img = img.astype(np.float32)
     if normalize:
         img /= 255.0  # кластер.py:737
     return img
+
+
+class CropDataset:
+    """Random-crop view over arbitrarily-sized scenes.
+
+    The reference's worker path opens aerial scenes of arbitrary size and
+    crops 512×512 from each (кластер.py:817-823, one fixed top-left crop);
+    this is the many-crops generalization that turns a directory of large
+    scenes into as many training tiles as the batch arithmetic needs.
+
+    ``len(ds)`` is ``crops_per_epoch``; crop positions are a pure function of
+    (seed, epoch, index), so every process computing the same epoch sees the
+    same global crop plan — exactly the property the sharded loader's
+    shared-permutation sampling relies on (loader.py).  Scenes are sampled
+    proportionally to their croppable area.
+    """
+
+    def __init__(
+        self,
+        scenes: "list[Tuple[np.ndarray, np.ndarray]]",
+        crop_size: Tuple[int, int],
+        crops_per_epoch: int,
+        seed: int = 0,
+    ):
+        if not scenes:
+            raise ValueError("CropDataset needs at least one scene")
+        ch, cw = crop_size
+        self.scenes = []
+        for i, (img, lab) in enumerate(scenes):
+            if img.shape[:2] != lab.shape[:2]:
+                raise ValueError(
+                    f"scene {i}: image {img.shape[:2]} != label {lab.shape[:2]}"
+                )
+            if img.shape[0] < ch or img.shape[1] < cw:
+                # Zero-pad undersized scenes up to one crop (reference pads
+                # nothing but also never checks; failing silently mislabels).
+                pad_h, pad_w = max(ch - img.shape[0], 0), max(cw - img.shape[1], 0)
+                img = np.pad(img, ((0, pad_h), (0, pad_w), (0, 0)))
+                lab = np.pad(lab, ((0, pad_h), (0, pad_w)))
+            self.scenes.append(
+                (
+                    np.ascontiguousarray(img, np.float32),
+                    np.ascontiguousarray(lab, np.int32),
+                )
+            )
+        self.crop_size = (ch, cw)
+        self.crops_per_epoch = int(crops_per_epoch)
+        if self.crops_per_epoch <= 0:
+            raise ValueError(f"crops_per_epoch must be > 0, got {crops_per_epoch}")
+        self.seed = seed
+        areas = np.array(
+            [
+                (img.shape[0] - ch + 1) * (img.shape[1] - cw + 1)
+                for img, _ in self.scenes
+            ],
+            np.float64,
+        )
+        self._scene_probs = areas / areas.sum()
+        self._epoch = 0
+        self._plan: Optional[np.ndarray] = None
+
+    def __len__(self) -> int:
+        return self.crops_per_epoch
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = int(epoch)
+        self._plan = None
+
+    def _crop_plan(self) -> np.ndarray:
+        """[crops_per_epoch, 3] (scene, y0, x0), deterministic per epoch."""
+        if self._plan is None:
+            rng = np.random.default_rng((self.seed, self._epoch))
+            ch, cw = self.crop_size
+            scene_ids = rng.choice(
+                len(self.scenes), size=self.crops_per_epoch, p=self._scene_probs
+            )
+            ys = np.empty(self.crops_per_epoch, np.int64)
+            xs = np.empty(self.crops_per_epoch, np.int64)
+            for i, s in enumerate(scene_ids):
+                img, _ = self.scenes[s]
+                ys[i] = rng.integers(0, img.shape[0] - ch + 1)
+                xs[i] = rng.integers(0, img.shape[1] - cw + 1)
+            self._plan = np.stack([scene_ids, ys, xs], axis=1)
+        return self._plan
+
+    def gather(self, indices: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        plan = self._crop_plan()
+        ch, cw = self.crop_size
+        n = len(indices)
+        c = self.scenes[0][0].shape[-1]
+        imgs = np.empty((n, ch, cw, c), np.float32)
+        labs = np.empty((n, ch, cw), np.int32)
+        for out, idx in enumerate(np.asarray(indices, np.int64)):
+            s, y0, x0 = plan[idx]
+            img, lab = self.scenes[s]
+            imgs[out] = img[y0 : y0 + ch, x0 : x0 + cw]
+            labs[out] = lab[y0 : y0 + ch, x0 : x0 + cw]
+        return imgs, labs
+
+    @property
+    def image_shape(self) -> Tuple[int, int, int]:
+        return (*self.crop_size, self.scenes[0][0].shape[-1])
+
+
+def grid_tiles(
+    scenes: "list[Tuple[np.ndarray, np.ndarray]]",
+    tile_size: Tuple[int, int],
+    max_tiles: Optional[int] = None,
+) -> TileDataset:
+    """Deterministic non-overlapping grid tiling of scenes → TileDataset.
+
+    The fixed-tile counterpart of :class:`CropDataset` for held-out
+    evaluation: mIoU must be computed on the same tiles every epoch.
+    """
+    th, tw = tile_size
+    images, labels = [], []
+    for img, lab in scenes:
+        for y in range(0, max(img.shape[0] - th, 0) + 1, th):
+            for x in range(0, max(img.shape[1] - tw, 0) + 1, tw):
+                tile_img = img[y : y + th, x : x + tw]
+                tile_lab = lab[y : y + th, x : x + tw]
+                if tile_img.shape[:2] != (th, tw):
+                    continue
+                images.append(np.asarray(tile_img, np.float32))
+                labels.append(np.asarray(tile_lab, np.int32))
+                if max_tiles is not None and len(images) >= max_tiles:
+                    break
+            else:
+                continue
+            break
+        if max_tiles is not None and len(images) >= max_tiles:
+            break
+    if not images:
+        raise ValueError(f"no {tile_size} tiles fit in any scene")
+    return TileDataset(np.stack(images), np.stack(labels))
+
+
+def load_scene_dir(
+    path: str, channels: int = 3, normalize: bool = True
+) -> "list[Tuple[np.ndarray, np.ndarray]]":
+    """Directory of images + ``.npy`` masks at native size → scene list.
+
+    Pairing is strict: image and mask must share a filename stem (modulo
+    ``_mask``/``_label``/``_gt`` suffixes); unmatched files raise.
+    """
+    img_by_stem, npy_by_stem = _paired_files(path)
+    scenes = []
+    for s in sorted(img_by_stem):
+        img = load_image_file(
+            img_by_stem[s], None, channels=channels, normalize=normalize
+        )
+        lab = np.load(npy_by_stem[s]).astype(np.int32)
+        scenes.append((img, lab))
+    return scenes
+
+
+def _paired_files(path: str) -> Tuple[dict, dict]:
+    """{stem: image_path}, {stem: npy_path} with strict 1:1 stem matching."""
+
+    def stem(f: str) -> str:
+        base = os.path.basename(f)
+        base = base[: base.rindex(".")] if "." in base else base
+        for suffix in ("_mask", "_label", "_labels", "_gt"):
+            base = base.removesuffix(suffix)
+        return base
+
+    img_by_stem: dict = {}
+    npy_by_stem: dict = {}
+    for name in sorted(os.listdir(path)):
+        full = os.path.join(path, name)
+        if not os.path.isfile(full):
+            continue
+        table = npy_by_stem if name.endswith(".npy") else img_by_stem
+        s = stem(name)
+        if s in table:
+            raise ValueError(
+                f"{path}: duplicate stem {s!r} ({table[s]} vs {full}) — "
+                f"cannot pair images and masks unambiguously"
+            )
+        table[s] = full
+    unmatched = sorted(
+        set(img_by_stem) ^ set(npy_by_stem)
+    )
+    if not img_by_stem or unmatched:
+        raise ValueError(
+            f"{path}: every image needs a .npy mask with the same stem "
+            f"(modulo _mask/_label/_gt suffixes); unmatched stems: "
+            f"{unmatched[:10]}"
+        )
+    return img_by_stem, npy_by_stem
 
 
 def load_tile_dir(
@@ -98,56 +302,19 @@ def load_tile_dir(
 ) -> TileDataset:
     """Read one directory of image files + ``.npy`` masks (кластер.py:660-674).
 
-    Pairing is by sorted order within each kind, exactly like the reference's
-    single-pass directory scan (it relies on interleaved naming; sorting the
-    two kinds independently is the robust version of the same contract).
-    Images are center-cropped/truncated to ``image_size`` the way the
-    reference crops ``[:512, :512]`` (кластер.py:822).
+    Pairing is strict by filename stem (modulo ``_mask``/``_label``/``_gt``
+    suffixes) and raises on unmatched files — the reference pairs by
+    directory-scan order, which silently mislabels every tile when the two
+    kinds' sort orders diverge (e.g. unpadded ``tile_10`` vs ``tile_2``).
+    Images are cropped/truncated to ``image_size`` the way the reference
+    crops ``[:512, :512]`` (кластер.py:822).
     """
-    import imageio.v2 as imageio
-
-    img_files, npy_files = [], []
-    for name in sorted(os.listdir(path)):
-        full = os.path.join(path, name)
-        if not os.path.isfile(full):
-            continue
-        (npy_files if name.endswith(".npy") else img_files).append(full)
-    if not img_files or len(img_files) != len(npy_files):
-        raise ValueError(
-            f"{path}: need equal numbers of image and .npy mask files, "
-            f"got {len(img_files)} images / {len(npy_files)} masks"
-        )
-    # Sorted-order pairing relies on consistent naming; catch schemes whose
-    # lexicographic orders diverge (e.g. zero-padded masks vs unpadded images)
-    # before they silently mislabel every tile.
-    def stem(f: str) -> str:
-        base = os.path.basename(f)
-        base = base[: base.rindex(".")] if "." in base else base
-        for suffix in ("_mask", "_label", "_labels", "_gt"):
-            base = base.removesuffix(suffix)
-        return base
-
-    mismatched = [
-        (i, stem(a), stem(b))
-        for i, (a, b) in enumerate(zip(img_files, npy_files))
-        if stem(a) != stem(b)
-        and not stem(b).startswith(stem(a))
-        and not stem(a).startswith(stem(b))
-    ]
-    if mismatched:
-        import warnings
-
-        i, a, b = mismatched[0]
-        warnings.warn(
-            f"{path}: image/mask pairing is by sorted order and pair {i} has "
-            f"unrelated stems ({a!r} vs {b!r}) — verify file naming",
-            stacklevel=2,
-        )
+    img_by_stem, npy_by_stem = _paired_files(path)
     images, labels = [], []
-    for img_f, npy_f in zip(img_files, npy_files):
-        lab = np.load(npy_f)
+    for s in sorted(img_by_stem):
+        lab = np.load(npy_by_stem[s])
         size = tuple(image_size) if image_size is not None else lab.shape[:2]
-        images.append(load_image_file(img_f, size, normalize=normalize))
+        images.append(load_image_file(img_by_stem[s], size, normalize=normalize))
         lab = lab[: size[0], : size[1]]
         if lab.shape != size:
             lab = np.pad(
@@ -217,8 +384,32 @@ def dataset_defaults(name: str, **overrides) -> DataConfig:
     return DataConfig(**kw)
 
 
-def build_dataset(cfg: DataConfig) -> Tuple[TileDataset, TileDataset]:
+def _synthetic_scenes(
+    cfg: DataConfig, channels: int
+) -> "list[Tuple[np.ndarray, np.ndarray]]":
+    """A few large Vaihingen-like scenes (~3 crops on a side each) so crop
+    mode is testable/benchmarkable without the ISPRS download."""
+    h, w = cfg.image_size
+    n_scenes = max(2, cfg.test_split_scenes + 1)
+    big = SyntheticTiles(
+        num_tiles=n_scenes,
+        image_size=(h * 3, w * 3),
+        channels=channels,
+        num_classes=cfg.num_classes,
+        seed=cfg.seed,
+    )
+    return [(big.images[i], big.labels[i]) for i in range(n_scenes)]
+
+
+def build_dataset(cfg: DataConfig):
     """(train, test) pair from a DataConfig; synthetic when data_dir unset.
+
+    Fixed-tile mode (``crops_per_epoch == 0``): the directory holds
+    ready-made tiles; last ``test_split`` are held out (кластер.py:672-673).
+    Crop mode (``crops_per_epoch > 0``): the directory holds full-size
+    scenes; train is a :class:`CropDataset` drawing ``crops_per_epoch``
+    random crops per epoch, test is a deterministic grid tiling of the last
+    ``test_split_scenes`` scenes.
 
     ``cfg`` is authoritative; a mismatch with the named dataset's known
     geometry (DATASET_SPECS) gets a warning so e.g. dataset='cityscapes'
@@ -241,10 +432,41 @@ def build_dataset(cfg: DataConfig) -> Tuple[TileDataset, TileDataset]:
                 f"this is unintended",
                 stacklevel=2,
             )
+    channels = (spec or DATASET_SPECS["synthetic"])["channels"]
+    if cfg.crops_per_epoch > 0:
+        scenes = (
+            load_scene_dir(cfg.data_dir)
+            if cfg.data_dir
+            else _synthetic_scenes(cfg, channels)
+        )
+        k = cfg.test_split_scenes
+        if k < 0 or (k > 0 and k >= len(scenes)):
+            raise ValueError(
+                f"test_split_scenes={k} must leave at least one training "
+                f"scene (directory has {len(scenes)})"
+            )
+        train_scenes = scenes[: len(scenes) - k] if k else scenes
+        train = CropDataset(
+            train_scenes,
+            crop_size=tuple(cfg.image_size),
+            crops_per_epoch=cfg.crops_per_epoch,
+            seed=cfg.seed,
+        )
+        if k:
+            test = grid_tiles(
+                scenes[len(scenes) - k :],
+                tuple(cfg.image_size),
+                max_tiles=cfg.test_split or None,
+            )
+        else:
+            test = TileDataset(
+                np.zeros((0, *cfg.image_size, channels), np.float32),
+                np.zeros((0, *cfg.image_size), np.int32),
+            )
+        return train, test
     if cfg.data_dir:
         ds = load_tile_dir(cfg.data_dir, image_size=tuple(cfg.image_size))
     else:
-        channels = (spec or DATASET_SPECS["synthetic"])["channels"]
         ds = SyntheticTiles(
             num_tiles=cfg.synthetic_len,
             image_size=tuple(cfg.image_size),

@@ -44,8 +44,15 @@ class ShardedLoader:
     One "item" feeds one optimizer step: ``sync_period`` micro-batches of
     global size ``global_micro_batch``.  Every process computes the same
     epoch permutation (seeded), takes its contiguous per-process slice, and
-    uploads only that slice; leftover tiles that don't fill a super-batch are
-    dropped (static shapes for XLA).
+    uploads only that slice.
+
+    ``tail='wrap'`` (default) pads the epoch to a whole number of
+    super-batches by wrapping the permutation, so every tile is seen at
+    least once per epoch regardless of batch arithmetic — the reference
+    consumes all 127 tiles each epoch at batch 1 (кластер.py:720-750), and
+    large-batch configs must not refuse reference-scale datasets.
+    ``tail='drop'`` keeps the old drop-remainder semantics (and rejects
+    datasets smaller than one super-batch).
     """
 
     def __init__(
@@ -59,6 +66,7 @@ class ShardedLoader:
         data_axis: str = "data",
         space_axis: Optional[str] = None,
         prefetch: int = 2,
+        tail: str = "wrap",
     ):
         self.ds = dataset
         self.mesh = mesh
@@ -85,20 +93,29 @@ class ShardedLoader:
             )
         self.local_micro_batch = global_micro_batch // nproc
         self.super_batch = global_micro_batch * sync_period
-        if len(dataset) < self.super_batch:
+        if tail not in ("wrap", "drop"):
+            raise ValueError(f"tail must be 'wrap' or 'drop', got {tail!r}")
+        self.tail = tail
+        if len(dataset) == 0:
+            raise ValueError("dataset is empty")
+        if tail == "drop" and len(dataset) < self.super_batch:
             raise ValueError(
                 f"dataset of {len(dataset)} tiles smaller than one super-batch "
-                f"({self.super_batch} = {global_micro_batch}×{sync_period}); "
-                f"reduce batch/sync_period or add data"
+                f"({self.super_batch} = {global_micro_batch}×{sync_period}) "
+                f"with tail='drop'; use tail='wrap', reduce batch/sync_period, "
+                f"or add data"
             )
         self.image_spec = P(None, data_axis, space_axis)  # [A, B, H, W, C]
         self.label_spec = P(None, data_axis, space_axis)  # [A, B, H, W]
 
     def __len__(self) -> int:
+        if self.tail == "wrap":
+            return -(-len(self.ds) // self.super_batch)
         return len(self.ds) // self.super_batch
 
     def set_epoch(self, epoch: int) -> None:
         self._epoch = epoch
+        self.ds.set_epoch(epoch)
 
     def _epoch_indices(self) -> np.ndarray:
         idx = np.arange(len(self.ds))
@@ -106,6 +123,10 @@ class ShardedLoader:
             # Same permutation on every process (shared seed), like
             # DistributedSampler.set_epoch; the per-process slice differs.
             np.random.default_rng(self.seed + self._epoch).shuffle(idx)
+        if self.tail == "wrap":
+            # Pad to a whole number of super-batches by wrapping, so every
+            # tile appears at least once and shapes stay static for XLA.
+            idx = np.resize(idx, len(self) * self.super_batch)
         return idx
 
     def _local_batches(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
@@ -116,11 +137,11 @@ class ShardedLoader:
             chunk = idx[start : start + self.super_batch].reshape(A, Bg)
             local = chunk[:, pid * Bl : (pid + 1) * Bl]  # [A, B_local]
             flat = local.reshape(-1)
-            imgs = self.ds.images[flat].reshape(
-                A, Bl, *self.ds.images.shape[1:]
+            imgs, labs = self.ds.gather(flat)
+            yield (
+                imgs.reshape(A, Bl, *imgs.shape[1:]),
+                labs.reshape(A, Bl, *labs.shape[1:]),
             )
-            labs = self.ds.labels[flat].reshape(A, Bl, *self.ds.labels.shape[1:])
-            yield imgs, labs
 
     def _upload(self, item: Tuple[np.ndarray, np.ndarray]):
         imgs, labs = item
@@ -217,11 +238,11 @@ def eval_batches(
         if valid < global_batch:
             idx = np.concatenate([idx, np.full(global_batch - valid, idx[-1])])
         local = idx[pid * bl : (pid + 1) * bl]
-        labels = dataset.labels[local].copy()
+        images, labels = dataset.gather(local)
         # Mark padded samples invalid: global positions >= valid.
         global_pos = np.arange(pid * bl, (pid + 1) * bl)
         labels[global_pos >= valid] = -1
         yield (
-            make_global_array(dataset.images[local], mesh, spec_x),
+            make_global_array(images, mesh, spec_x),
             make_global_array(labels, mesh, spec_y),
         )

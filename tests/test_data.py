@@ -75,15 +75,16 @@ def test_load_tile_dir_mismatch_raises(tmp_path):
 
 
 def test_sharded_loader_epoch_coverage_disjoint(mesh):
-    """One epoch covers each tile at most once (no duplication across the
-    batch dimension — the reference's replicas all process every tile)."""
+    """With tail='drop', one epoch covers each tile at most once (no
+    duplication across the batch dimension — the reference's replicas all
+    process every tile)."""
     ds = SyntheticTiles(num_tiles=33, image_size=(8, 8), seed=2)
     # Tag each tile with a unique corner value to track identity.
     for i in range(len(ds)):
         ds.images[i, 0, 0, 0] = i / 100.0
     loader = ShardedLoader(
         ds, mesh, global_micro_batch=8, sync_period=2, shuffle=True, seed=0,
-        prefetch=0,
+        prefetch=0, tail="drop",
     )
     assert len(loader) == 2  # 33 // 16
     seen = []
@@ -94,6 +95,38 @@ def test_sharded_loader_epoch_coverage_disjoint(mesh):
         seen.extend(ids.reshape(-1).tolist())
     assert len(seen) == 32
     assert len(set(seen)) == 32  # disjoint — every tile distinct
+
+
+def test_sharded_loader_wrap_covers_every_tile(mesh):
+    """Default tail='wrap': the epoch pads to whole super-batches by wrapping
+    the permutation, so every tile is seen ≥ once and at most twice —
+    including datasets smaller than one super-batch (the reference consumes
+    all 127 tiles per epoch; large-batch configs must not refuse that scale,
+    VERDICT r1)."""
+    ds = SyntheticTiles(num_tiles=33, image_size=(8, 8), seed=2)
+    for i in range(len(ds)):
+        ds.images[i, 0, 0, 0] = i / 100.0
+    loader = ShardedLoader(
+        ds, mesh, global_micro_batch=8, sync_period=2, shuffle=True, seed=0,
+        prefetch=0,
+    )
+    assert len(loader) == 3  # ceil(33 / 16)
+    seen = []
+    for imgs, labs in loader:
+        ids = np.round(np.asarray(imgs)[:, :, 0, 0, 0] * 100).astype(int)
+        seen.extend(ids.reshape(-1).tolist())
+    assert len(seen) == 48
+    assert set(seen) == set(range(33))  # full coverage
+    counts = np.bincount(seen)
+    assert counts.max() <= 2  # wrap repeats each tile at most once more
+
+    # Smaller than one super-batch: still serves one full super-batch.
+    tiny = SyntheticTiles(num_tiles=5, image_size=(8, 8))
+    loader = ShardedLoader(tiny, mesh, global_micro_batch=8, sync_period=2,
+                           prefetch=0)
+    assert len(loader) == 1
+    (imgs, labs), = list(loader)
+    assert imgs.shape == (2, 8, 8, 8, 3)
 
 
 def test_sharded_loader_reshuffles_per_epoch(mesh):
@@ -143,10 +176,17 @@ def test_sharded_loader_prefetch_matches_sync(mesh):
         np.testing.assert_array_equal(b0, b1)
 
 
-def test_sharded_loader_too_small_raises(mesh):
+def test_sharded_loader_too_small_raises_with_drop(mesh):
     ds = SyntheticTiles(num_tiles=8, image_size=(8, 8))
-    with pytest.raises(ValueError):
-        ShardedLoader(ds, mesh, global_micro_batch=8, sync_period=2)
+    with pytest.raises(ValueError, match="drop"):
+        ShardedLoader(ds, mesh, global_micro_batch=8, sync_period=2, tail="drop")
+    with pytest.raises(ValueError, match="empty"):
+        ShardedLoader(
+            TileDataset(
+                np.zeros((0, 8, 8, 3), np.float32), np.zeros((0, 8, 8), np.int32)
+            ),
+            mesh, global_micro_batch=8,
+        )
 
 
 def test_prefetch_propagates_producer_errors(mesh):
@@ -191,6 +231,177 @@ def test_dataset_defaults():
     assert cfg.image_size == (512, 1024)
     assert cfg.num_classes == 19
     assert cfg.synthetic_len == 8
+
+
+def _toy_scenes(n=3, h=40, w=56, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            rng.uniform(0, 1, (h + 8 * i, w + 8 * i, 3)).astype(np.float32),
+            rng.integers(0, classes, (h + 8 * i, w + 8 * i)).astype(np.int32),
+        )
+        for i in range(n)
+    ]
+
+
+def test_crop_dataset_shapes_and_determinism():
+    from ddlpc_tpu.data import CropDataset
+
+    ds = CropDataset(_toy_scenes(), crop_size=(16, 16), crops_per_epoch=20, seed=1)
+    assert len(ds) == 20
+    assert ds.image_shape == (16, 16, 3)
+    imgs, labs = ds.gather(np.arange(20))
+    assert imgs.shape == (20, 16, 16, 3) and labs.shape == (20, 16, 16)
+    # Same epoch → identical crops; new epoch → different crop plan.
+    imgs2, _ = ds.gather(np.arange(20))
+    np.testing.assert_array_equal(imgs, imgs2)
+    ds.set_epoch(1)
+    imgs3, _ = ds.gather(np.arange(20))
+    assert not np.array_equal(imgs, imgs3)
+    ds.set_epoch(0)
+    imgs4, _ = ds.gather(np.arange(20))
+    np.testing.assert_array_equal(imgs, imgs4)
+
+
+def test_crop_dataset_crops_match_scene_content():
+    """Every crop must be an exact window of some scene (image and label
+    from the SAME window — the mislabeling failure mode of positional
+    pairing)."""
+    from ddlpc_tpu.data import CropDataset
+
+    scenes = _toy_scenes(n=1, h=32, w=32)
+    img, lab = scenes[0]
+    ds = CropDataset(scenes, crop_size=(8, 8), crops_per_epoch=10, seed=3)
+    imgs, labs = ds.gather(np.arange(10))
+    for k in range(10):
+        found = False
+        for y in range(25):
+            for x in range(25):
+                if np.array_equal(imgs[k], img[y : y + 8, x : x + 8]):
+                    np.testing.assert_array_equal(
+                        labs[k], lab[y : y + 8, x : x + 8]
+                    )
+                    found = True
+                    break
+            if found:
+                break
+        assert found
+
+
+def test_crop_dataset_pads_undersized_scene():
+    from ddlpc_tpu.data import CropDataset
+
+    scenes = [
+        (
+            np.ones((8, 8, 3), np.float32),
+            np.ones((8, 8), np.int32),
+        )
+    ]
+    ds = CropDataset(scenes, crop_size=(16, 16), crops_per_epoch=2)
+    imgs, labs = ds.gather(np.array([0, 1]))
+    assert imgs.shape == (2, 16, 16, 3)
+    assert imgs[0, :8, :8].min() == 1.0 and imgs[0, 8:, 8:].max() == 0.0
+
+
+def test_grid_tiles_deterministic():
+    from ddlpc_tpu.data import grid_tiles
+
+    scenes = _toy_scenes(n=2, h=40, w=56)
+    ds = grid_tiles(scenes, (16, 16))
+    # scene0 40×56 → 2×3 tiles; scene1 48×64 → 3×4 tiles.
+    assert len(ds) == 6 + 12
+    np.testing.assert_array_equal(ds.images[0], scenes[0][0][:16, :16])
+    capped = grid_tiles(scenes, (16, 16), max_tiles=5)
+    assert len(capped) == 5
+
+
+def test_load_scene_dir_strict_pairing(tmp_path):
+    import imageio.v2 as imageio
+
+    from ddlpc_tpu.data import load_scene_dir
+
+    rng = np.random.default_rng(0)
+    for name in ("tile_2", "tile_10"):  # lexicographic trap for sorted pairing
+        imageio.imwrite(
+            tmp_path / f"{name}.png",
+            rng.integers(0, 255, (24, 24, 3), dtype=np.uint8),
+        )
+        np.save(tmp_path / f"{name}_mask.npy", rng.integers(0, 6, (24, 24)))
+    scenes = load_scene_dir(str(tmp_path))
+    assert len(scenes) == 2
+    assert scenes[0][0].shape == (24, 24, 3)
+    # Unmatched stem → hard error, not a warning.
+    np.save(tmp_path / "orphan.npy", np.zeros((4, 4)))
+    with pytest.raises(ValueError, match="orphan"):
+        load_scene_dir(str(tmp_path))
+
+
+def test_load_tile_dir_unmatched_stem_raises(tmp_path):
+    import imageio.v2 as imageio
+
+    imageio.imwrite(
+        tmp_path / "a.png", np.zeros((8, 8, 3), np.uint8)
+    )
+    np.save(tmp_path / "b.npy", np.zeros((8, 8)))
+    with pytest.raises(ValueError, match="stem"):
+        load_tile_dir(str(tmp_path))
+
+
+def test_build_dataset_crop_mode():
+    cfg = DataConfig(
+        dataset="synthetic",
+        image_size=(16, 16),
+        num_classes=4,
+        crops_per_epoch=24,
+        test_split_scenes=1,
+        test_split=6,
+    )
+    train, test = build_dataset(cfg)
+    assert len(train) == 24
+    assert train.image_shape == (16, 16, 3)
+    assert len(test) == 6  # grid tiles capped at test_split
+    assert test.images.shape[1:] == (16, 16, 3)
+
+
+def test_build_dataset_crop_mode_from_dir(tmp_path):
+    import imageio.v2 as imageio
+
+    rng = np.random.default_rng(0)
+    for i in range(2):
+        imageio.imwrite(
+            tmp_path / f"scene_{i}.png",
+            rng.integers(0, 255, (48, 48, 3), dtype=np.uint8),
+        )
+        np.save(tmp_path / f"scene_{i}.npy", rng.integers(0, 6, (48, 48)))
+    cfg = DataConfig(
+        data_dir=str(tmp_path),
+        dataset="synthetic",
+        image_size=(16, 16),
+        crops_per_epoch=10,
+        test_split_scenes=1,
+    )
+    train, test = build_dataset(cfg)
+    assert len(train) == 10
+    assert len(test) == 9  # 48/16 = 3×3 grid of the held-out scene
+
+
+def test_crop_loader_end_to_end(mesh):
+    """CropDataset behind the ShardedLoader: epoch determinism and shapes."""
+    from ddlpc_tpu.data import CropDataset
+
+    ds = CropDataset(_toy_scenes(), crop_size=(8, 8), crops_per_epoch=40, seed=2)
+    loader = ShardedLoader(
+        ds, mesh, global_micro_batch=8, sync_period=2, shuffle=True, prefetch=0
+    )
+    assert len(loader) == 3  # ceil(40/16)
+    loader.set_epoch(0)
+    a = [np.asarray(x) for x, _ in loader]
+    loader.set_epoch(1)
+    b = [np.asarray(x) for x, _ in loader]
+    loader.set_epoch(0)
+    c = [np.asarray(x) for x, _ in loader]
+    assert all(np.array_equal(x, z) for x, z in zip(a, c))
+    assert not all(np.array_equal(x, z) for x, z in zip(a, b))
 
 
 def test_eval_batches_padding_masks_labels(mesh):
