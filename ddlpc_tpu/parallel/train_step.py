@@ -341,3 +341,22 @@ def make_predict_fn(
         return jnp.argmax(logits, axis=-1)
 
     return predict
+
+
+def make_logits_fn(
+    model: nn.Module,
+) -> Callable[[TrainState, jax.Array], jax.Array]:
+    """Single-device jitted inference returning raw logits [N,H,W,C] —
+    the building block for sliding-window full-scene prediction, where
+    overlapping windows blend *logits* (argmaxing per window first would
+    make the overlap vote instead of average)."""
+
+    @jax.jit
+    def logits_fn(state: TrainState, images: jax.Array) -> jax.Array:
+        return model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            images,
+            train=False,
+        )
+
+    return logits_fn

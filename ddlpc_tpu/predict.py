@@ -1,8 +1,10 @@
 """Inference CLI: ``python -m ddlpc_tpu.predict --workdir runs/x --input dir``.
 
 The reference has no inference path at all — its closest artifact is the
-in-training PNG dump (кластер.py:785-790).  This restores a trained
-checkpoint and writes a color-mapped class-map PNG per input image.
+in-training PNG dump of fixed 512×512 crops (кластер.py:785-790,817-823).
+This restores a trained checkpoint and predicts each input image at its
+NATIVE size via overlap-blended sliding windows, writing a color-mapped
+class-map PNG per input.
 """
 
 from __future__ import annotations
@@ -10,8 +12,119 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+from typing import Callable, Tuple
 
 import numpy as np
+
+
+def _blend_window(tile: Tuple[int, int]) -> np.ndarray:
+    """[th, tw] separable triangular weights, strictly positive, peaked at
+    the window center — overlapping windows cross-fade instead of seaming."""
+
+    def ramp(n: int) -> np.ndarray:
+        x = np.arange(n, dtype=np.float32)
+        return np.minimum(x + 1.0, n - x) / ((n + 1) / 2)
+
+    return np.outer(ramp(tile[0]), ramp(tile[1])).astype(np.float32)
+
+
+def sliding_window_logits(
+    logits_fn: Callable[..., np.ndarray],
+    state,
+    image: np.ndarray,
+    tile: Tuple[int, int],
+    overlap: float = 0.25,
+    batch: int = 8,
+) -> np.ndarray:
+    """Full-scene logits [H, W, C] for an arbitrary-size image [H, W, c].
+
+    Covers the scene with ``tile``-sized windows at stride
+    ``tile·(1-overlap)`` (the last row/column snaps flush to the edge, so
+    coverage is exact without padding unless the scene is smaller than one
+    tile), runs the compiled ``logits_fn`` on fixed-size window batches, and
+    blends overlaps with triangular weights.
+    """
+    if not 0.0 <= overlap < 1.0:
+        # A negative overlap would stride past the tile, leaving wsum==0
+        # gaps whose 0/0 logits silently argmax to class 0.
+        raise ValueError(f"overlap must be in [0, 1), got {overlap}")
+    th, tw = tile
+    h, w = image.shape[:2]
+    pad_h, pad_w = max(th - h, 0), max(tw - w, 0)
+    if pad_h or pad_w:
+        image = np.pad(image, ((0, pad_h), (0, pad_w), (0, 0)))
+    H, W = image.shape[:2]
+
+    def starts(extent: int, size: int, stride: int) -> list[int]:
+        out = list(range(0, extent - size + 1, stride))
+        if out[-1] != extent - size:
+            out.append(extent - size)
+        return out
+
+    sh = max(int(th * (1.0 - overlap)), 1)
+    sw = max(int(tw * (1.0 - overlap)), 1)
+    origins = [(y, x) for y in starts(H, th, sh) for x in starts(W, tw, sw)]
+
+    weight = _blend_window(tile)
+    acc: np.ndarray | None = None
+    wsum = np.zeros((H, W, 1), np.float32)
+    for i in range(0, len(origins), batch):
+        chunk = origins[i : i + batch]
+        windows = np.stack(
+            [image[y : y + th, x : x + tw] for y, x in chunk]
+        )
+        valid = len(chunk)
+        if valid < batch:  # pad to the compiled batch size
+            windows = np.concatenate(
+                [windows, np.repeat(windows[-1:], batch - valid, axis=0)]
+            )
+        logits = np.asarray(logits_fn(state, windows), np.float32)[:valid]
+        if acc is None:
+            acc = np.zeros((H, W, logits.shape[-1]), np.float32)
+        for (y, x), tile_logits in zip(chunk, logits):
+            acc[y : y + th, x : x + tw] += tile_logits * weight[..., None]
+            wsum[y : y + th, x : x + tw, 0] += weight
+    assert acc is not None
+    out = acc / wsum
+    return out[:h, :w]
+
+
+def load_run(workdir: str):
+    """(cfg, state, logits_fn, channels) restored from a training run.
+
+    Input channel count comes from the checkpoint metadata (the Trainer
+    records what the dataset actually had) — NOT a hardcoded 3, which made
+    non-RGB checkpoints unrestorable (ADVICE r1).
+    """
+    import jax
+
+    from ddlpc_tpu.config import ExperimentConfig
+    from ddlpc_tpu.models import build_model
+    from ddlpc_tpu.parallel.train_step import (
+        create_train_state,
+        make_logits_fn,
+    )
+    from ddlpc_tpu.train import checkpoint as ckpt
+    from ddlpc_tpu.train.optim import build_optimizer
+
+    with open(os.path.join(workdir, "config.json")) as f:
+        cfg = ExperimentConfig.from_json(f.read())
+    ckpt_dir = os.path.join(workdir, "checkpoints")
+    step = ckpt.latest_step(ckpt_dir)
+    if step is None:
+        raise FileNotFoundError(f"no checkpoints in {ckpt_dir}")
+    meta = ckpt.peek_metadata(ckpt_dir, step)
+    channels = int(meta.get("input_channels", 3))
+    # Inference is single-device: no mesh axis for BN stats.
+    model = build_model(cfg.model, norm_axis_name=None)
+    tx = build_optimizer(cfg.train)
+    h, w = cfg.data.image_size
+    state = create_train_state(
+        model, tx, jax.random.key(0), (1, h, w, channels)
+    )
+    state, meta = ckpt.restore_checkpoint(ckpt_dir, state)
+    print(f"restored step {meta.get('step')} (epoch {meta.get('epoch')})")
+    return cfg, state, make_logits_fn(model), channels
 
 
 def main(argv=None) -> int:
@@ -20,36 +133,24 @@ def main(argv=None) -> int:
     p.add_argument("--input", required=True, help="directory of images")
     p.add_argument("--output", help="output directory (default <workdir>/predictions)")
     p.add_argument("--batch", type=int, default=8)
+    p.add_argument(
+        "--overlap",
+        type=float,
+        default=0.25,
+        help="sliding-window overlap fraction (0 = edge-to-edge tiling)",
+    )
     args = p.parse_args(argv)
 
-    import jax
     from PIL import Image
 
-    from ddlpc_tpu.config import ExperimentConfig
-    from ddlpc_tpu.models import build_model
-    from ddlpc_tpu.parallel.train_step import create_train_state, make_predict_fn
-    from ddlpc_tpu.train import checkpoint as ckpt
     from ddlpc_tpu.train.observability import class_palette
-    from ddlpc_tpu.train.optim import build_optimizer
 
-    with open(os.path.join(args.workdir, "config.json")) as f:
-        cfg = ExperimentConfig.from_json(f.read())
-    # Inference is single-device: no mesh axis for BN stats.
-    model = build_model(cfg.model, norm_axis_name=None)
-    tx = build_optimizer(cfg.train)
+    cfg, state, logits_fn, channels = load_run(args.workdir)
     h, w = cfg.data.image_size
-    state = create_train_state(model, tx, jax.random.key(0), (1, h, w, 3))
-    state, meta = ckpt.restore_checkpoint(
-        os.path.join(args.workdir, "checkpoints"), state
-    )
-    print(f"restored step {meta.get('step')} (epoch {meta.get('epoch')})")
-    predict = make_predict_fn(model)
 
     out_dir = args.output or os.path.join(args.workdir, "predictions")
     os.makedirs(out_dir, exist_ok=True)
     pal = class_palette(cfg.model.num_classes)
-
-    from ddlpc_tpu.data.datasets import load_image_file
 
     names = sorted(
         n
@@ -59,23 +160,27 @@ def main(argv=None) -> int:
     if not names:
         print(f"no images found in {args.input}", file=sys.stderr)
         return 1
-    for start in range(0, len(names), args.batch):
-        chunk = names[start : start + args.batch]
-        batch = np.stack(
-            [load_image_file(os.path.join(args.input, n), (h, w)) for n in chunk]
+    from ddlpc_tpu.data.datasets import load_image_file
+
+    for n in names:
+        # Native size (image_size=None): the sliding window handles any
+        # geometry; preprocessing stays shared with the training readers.
+        image = load_image_file(
+            os.path.join(args.input, n), None, channels=channels
         )
-        # Pad the tail to the compiled batch size.
-        valid = len(chunk)
-        if valid < args.batch:
-            batch = np.concatenate(
-                [batch, np.repeat(batch[-1:], args.batch - valid, axis=0)]
-            )
-        preds = np.asarray(predict(state, batch))[:valid]
-        for n, pred in zip(chunk, preds):
-            stem = n.rsplit(".", 1)[0]
-            Image.fromarray(pal[np.clip(pred, 0, cfg.model.num_classes - 1)]).save(
-                os.path.join(out_dir, f"{stem}_pred.png")
-            )
+        logits = sliding_window_logits(
+            logits_fn,
+            state,
+            image,
+            tile=(h, w),
+            overlap=args.overlap,
+            batch=args.batch,
+        )
+        pred = np.argmax(logits, axis=-1)
+        stem = n.rsplit(".", 1)[0]
+        Image.fromarray(pal[np.clip(pred, 0, cfg.model.num_classes - 1)]).save(
+            os.path.join(out_dir, f"{stem}_pred.png")
+        )
     print(f"wrote {len(names)} predictions to {out_dir}")
     return 0
 

@@ -5,8 +5,10 @@ anywhere; a crash loses the run).  Design:
   pytree plus a JSON sidecar (step/epoch/config) — all host arrays; on
   restore the caller re-uploads to the mesh (params are replicated, so a
   plain device_put suffices).
-- Writes are atomic (tmp file + rename) and pruned to ``keep`` newest, so a
-  crash mid-write can never corrupt the latest restorable state.
+- Writes are atomic and durable (tmp file + fsync + rename + directory
+  fsync) and pruned to ``keep`` newest, so neither a process crash mid-write
+  nor a power loss after _prune can leave a renamed-but-empty blob as the
+  only checkpoint.
 - Only process 0 writes (state is replicated across hosts); every process
   can restore from shared storage.
 - The blob is compressed with the framework wire codec (utils/wire.py —
@@ -69,16 +71,30 @@ def save_checkpoint(
     meta_tmp = os.path.join(ckpt_dir, f".meta_{step}.tmp")
     with open(meta_tmp, "w") as f:
         json.dump(meta, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(meta_tmp, os.path.join(ckpt_dir, f"ckpt_{step}.json"))
     fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
             f.write(blob)
+            f.flush()
+            # fsync before rename: os.replace alone is atomic against
+            # process crashes but not power loss — an un-synced blob could
+            # survive the rename empty while _prune already deleted the
+            # older checkpoints.
+            os.fsync(f.fileno())
         os.replace(tmp, os.path.join(ckpt_dir, name))
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+    # Persist both renames before pruning the fallback checkpoints.
+    dir_fd = os.open(ckpt_dir, os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
     _prune(ckpt_dir, keep)
     return os.path.join(ckpt_dir, name)
 
@@ -114,6 +130,21 @@ def _prune(ckpt_dir: str, keep: int) -> None:
 def latest_step(ckpt_dir: str) -> Optional[int]:
     steps = _steps(ckpt_dir)
     return steps[-1] if steps else None
+
+
+def peek_metadata(ckpt_dir: str, step: Optional[int] = None) -> dict:
+    """Read a checkpoint's JSON sidecar without touching the blob — for
+    callers that need metadata (e.g. input_channels) BEFORE they can build
+    the restore target."""
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {ckpt_dir}")
+    meta_path = os.path.join(ckpt_dir, f"ckpt_{step}.json")
+    if not os.path.exists(meta_path):
+        return {}
+    with open(meta_path) as f:
+        return json.load(f)
 
 
 def restore_checkpoint(

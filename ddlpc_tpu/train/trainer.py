@@ -264,7 +264,13 @@ class Trainer:
             self.ckpt_dir,
             self.state,
             step=int(jax.device_get(self.state.step)),
-            metadata={"epoch": epoch, "config": self.cfg.to_dict()},
+            metadata={
+                "epoch": epoch,
+                "config": self.cfg.to_dict(),
+                # The predict CLI rebuilds its restore target from this —
+                # channels come from the dataset, not the config (ADVICE r1).
+                "input_channels": int(self.train_ds.image_shape[-1]),
+            },
             keep=self.cfg.train.keep_checkpoints,
         )
 
