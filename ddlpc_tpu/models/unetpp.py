@@ -8,10 +8,12 @@ norm selection and up-sample mode behave identically across the zoo.
 
 Architecture (Zhou et al. 2018): node X[i][j] at depth i receives the
 concatenation of all same-depth predecessors X[i][0..j-1] plus the upsampled
-X[i+1][j-1].  With deep supervision each X[0][j], j≥1 gets a 1×1 logit head;
-training averages the heads' losses (here: averages the logits, equivalent
-up to the softmax nonlinearity and standard practice for inference pruning),
-and inference can stop at any head.
+X[i+1][j-1].  With deep supervision each X[0][j], j≥1 gets a 1×1 logit head.
+Training returns the stacked per-head logits [J, N, H, W, C] so the loss is
+the average of per-head cross-entropies (the paper's formulation — averaging
+logits before one softmax would couple the heads' gradients); inference
+returns the mean of the heads' logits (standard ensemble readout, and any
+head prefix can be pruned).
 """
 
 from __future__ import annotations
@@ -42,8 +44,13 @@ class UNetPP(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
         """x: [N,H,W,C] float; H, W divisible by 2**(len(features)-1).
-        Returns logits [N,H,W,num_classes] float32 (deep supervision: the
-        mean of all supervised heads)."""
+
+        Returns float32 logits: [N,H,W,num_classes] — except with deep
+        supervision under ``train=True``, where the stacked per-head logits
+        [J,N,H,W,num_classes] come back so the loss averages per-head
+        cross-entropies (losses broadcast labels over leading axes, so
+        ``softmax_cross_entropy(stacked, labels)`` IS the mean of the
+        per-head losses)."""
         x = x.astype(self.dtype)
         depth = len(self.features)
         common = dict(
@@ -81,8 +88,8 @@ class UNetPP(nn.Module):
             )(h.astype(jnp.float32))
 
         if self.deep_supervision:
-            logits = [
-                head(grid[(0, j)], f"head_{j}") for j in range(1, depth)
-            ]
-            return jnp.mean(jnp.stack(logits), axis=0)
+            logits = jnp.stack(
+                [head(grid[(0, j)], f"head_{j}") for j in range(1, depth)]
+            )
+            return logits if train else jnp.mean(logits, axis=0)
         return head(grid[(0, depth - 1)], "head")

@@ -1,5 +1,6 @@
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from ddlpc_tpu.config import ModelConfig
@@ -111,18 +112,22 @@ def test_unet_s2d_stem_shapes():
     assert logits.shape == (2, 64, 64, 6)
 
 
-def test_unet_s2d_stem_learns(tmp_path):
+@pytest.mark.parametrize("stem_factor", [2, 4])
+def test_unet_s2d_stem_learns(tmp_path, stem_factor):
     """The TPU-optimized stem must actually train to the same place the
-    plain stem does on synthetic tiles (guards the bench flagship)."""
+    plain stem does on synthetic tiles — at BOTH factors; factor 4 is the
+    headline bench flagship (bench.py)."""
     from ddlpc_tpu.config import DataConfig, ExperimentConfig, TrainConfig
     from ddlpc_tpu.train.trainer import Trainer
 
     cfg = ExperimentConfig(
         model=ModelConfig(
             features=(8, 16), bottleneck_features=16, num_classes=4,
-            stem="s2d",
+            stem="s2d", stem_factor=stem_factor,
         ),
-        data=DataConfig(dataset="synthetic", image_size=(32, 32),
+        # 64² tiles: at 32² the synthetic label grid degenerates to one
+        # class per tile, which under-constrains the factor-4 subpixel head.
+        data=DataConfig(dataset="synthetic", image_size=(64, 64),
                         synthetic_len=40, test_split=8, num_classes=4),
         train=TrainConfig(epochs=25, micro_batch_size=1, sync_period=2,
                           learning_rate=3e-3, dump_images_per_epoch=0,
@@ -182,6 +187,34 @@ def test_unetpp_trains():
     norms = [float(jnp.abs(g).max()) for g in jax.tree.leaves(grads)]
     assert all(jnp.isfinite(n) for n in norms)
     assert max(norms) > 0  # gradients actually flow through the nested grid
+
+
+def test_unetpp_train_returns_stacked_heads_per_head_loss():
+    """Deep supervision trains on per-head CE averages (Zhou et al. 2018),
+    not on pre-softmax logit averages (ADVICE r1)."""
+    from ddlpc_tpu.ops.losses import softmax_cross_entropy
+
+    cfg = ModelConfig(
+        name="unetpp", num_classes=3, features=(4, 8, 16), deep_supervision=True
+    )
+    model = build_model(cfg)
+    x = jax.random.uniform(jax.random.PRNGKey(0), (2, 16, 16, 3))
+    y = jax.random.randint(jax.random.PRNGKey(1), (2, 16, 16), 0, 3)
+    variables = model.init(jax.random.PRNGKey(2), x, train=False)
+    stacked, _ = model.apply(
+        variables, x, train=True, mutable=["batch_stats"]
+    )
+    assert stacked.shape == (2, 2, 16, 16, 3)  # [J=depth-1, N, H, W, C]
+    # CE over the stacked tensor == mean of the per-head CEs.
+    per_head = jnp.stack(
+        [softmax_cross_entropy(stacked[j], y) for j in range(2)]
+    ).mean()
+    np.testing.assert_allclose(
+        float(softmax_cross_entropy(stacked, y)), float(per_head), rtol=1e-6
+    )
+    # Inference still returns one ensemble logit map.
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 16, 16, 3)
 
 
 @pytest.mark.parametrize("output_stride", [8, 16])
