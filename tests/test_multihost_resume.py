@@ -1,0 +1,159 @@
+"""Multi-host resume synchronization (VERDICT r1 weak #6).
+
+``Trainer._restore_synchronized``'s ``process_count > 1`` branch is the one
+place a desynchronized decision hangs a pod: only process 0 writes
+checkpoints, so every other process must learn "was there a checkpoint, and
+which epoch" from the broadcast, never from local disk.  Real multi-process
+JAX isn't available in CI, so these tests drive the branch with a patched
+process topology and a recording broadcast stub — verifying the *decision
+protocol* (what is broadcast, who applies what), which is exactly the logic
+that desynchronizes (the collective transport itself is jax-library code).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+from jax.experimental import multihost_utils
+
+from ddlpc_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from ddlpc_tpu.train import checkpoint as ckpt
+from ddlpc_tpu.train.trainer import Trainer
+
+
+def tiny_config(workdir: str) -> ExperimentConfig:
+    return ExperimentConfig(
+        model=ModelConfig(features=(4, 8), bottleneck_features=8, num_classes=4),
+        data=DataConfig(
+            dataset="synthetic",
+            image_size=(16, 16),
+            synthetic_len=20,
+            test_split=4,
+            num_classes=4,
+        ),
+        train=TrainConfig(
+            epochs=1,
+            micro_batch_size=1,
+            sync_period=1,
+            dump_images_per_epoch=0,
+        ),
+        workdir=workdir,
+    )
+
+
+@pytest.fixture()
+def trained_workdir(tmp_path):
+    """A run with one saved checkpoint (epoch 3)."""
+    workdir = str(tmp_path / "run")
+    trainer = Trainer(tiny_config(workdir), resume=False)
+    trainer.save(epoch=3)
+    return workdir, trainer
+
+
+class RecordingBroadcast:
+    """Stands in for multihost_utils.broadcast_one_to_all.
+
+    On the "source" process it returns the input unchanged (what the real
+    collective does for process 0) and records it; on a "receiver" it
+    returns the scripted payloads a real process 0 would have contributed.
+    """
+
+    def __init__(self, scripted=None):
+        self.calls = []
+        self.scripted = list(scripted or [])
+
+    def __call__(self, value):
+        self.calls.append(value)
+        if self.scripted:
+            return self.scripted.pop(0)
+        return value
+
+
+def _patch_topology(monkeypatch, count: int, index: int, bcast):
+    monkeypatch.setattr(jax, "process_count", lambda: count)
+    monkeypatch.setattr(jax, "process_index", lambda: index)
+    monkeypatch.setattr(multihost_utils, "broadcast_one_to_all", bcast)
+
+
+def test_process0_broadcasts_found_epoch_and_state(
+    trained_workdir, monkeypatch
+):
+    workdir, trainer = trained_workdir
+    resumed = Trainer(tiny_config(workdir), resume=False)
+    bcast = RecordingBroadcast()
+    _patch_topology(monkeypatch, count=2, index=0, bcast=bcast)
+    resumed._restore_synchronized()
+    # Broadcast #1: the (found, next_epoch) decision flags.
+    np.testing.assert_array_equal(bcast.calls[0], np.array([1, 4], np.int32))
+    # Broadcast #2: the restored state pytree (params included).
+    assert len(bcast.calls) == 2
+    assert resumed.start_epoch == 4
+    for a, b in zip(
+        jax.tree.leaves(resumed.state.params),
+        jax.tree.leaves(trainer.state.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_nonzero_process_applies_broadcast_not_local_disk(
+    trained_workdir, tmp_path, monkeypatch
+):
+    """Process 1 has NO local checkpoints (non-shared storage) and must take
+    everything from the broadcast."""
+    workdir, trainer = trained_workdir
+    # Fresh workdir with no checkpoints: local disk says "nothing to resume".
+    lonely = str(tmp_path / "proc1")
+    resumed = Trainer(tiny_config(lonely), resume=False)
+    state0, _ = ckpt.restore_checkpoint(
+        os.path.join(workdir, "checkpoints"), resumed.state
+    )
+    bcast = RecordingBroadcast(
+        scripted=[np.array([1, 4], np.int32), state0]
+    )
+    _patch_topology(monkeypatch, count=2, index=1, bcast=bcast)
+    resumed._restore_synchronized()
+    # It contributed its own (not-found) flags, then took process 0's state.
+    np.testing.assert_array_equal(bcast.calls[0], np.array([0, 0], np.int32))
+    assert resumed.start_epoch == 4
+    for a, b in zip(
+        jax.tree.leaves(resumed.state.params),
+        jax.tree.leaves(trainer.state.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_no_checkpoint_anywhere_skips_state_broadcast(tmp_path, monkeypatch):
+    """With found=0 no process may enter the state broadcast (a mismatched
+    collective count is exactly the hang this protocol exists to prevent)."""
+    resumed = Trainer(tiny_config(str(tmp_path / "none")), resume=False)
+    bcast = RecordingBroadcast()
+    _patch_topology(monkeypatch, count=2, index=0, bcast=bcast)
+    resumed._restore_synchronized()
+    assert len(bcast.calls) == 1  # flags only, no state broadcast
+    assert resumed.start_epoch == 0
+
+
+def test_epochless_metadata_still_restores_weights(
+    trained_workdir, monkeypatch
+):
+    """A checkpoint whose sidecar lost its epoch must still restore weights,
+    resuming at epoch 0 (matching the single-process branch)."""
+    workdir, trainer = trained_workdir
+    ckpt_dir = os.path.join(workdir, "checkpoints")
+    step = ckpt.latest_step(ckpt_dir)
+    meta_path = os.path.join(ckpt_dir, f"ckpt_{step}.json")
+    os.unlink(meta_path)
+    resumed = Trainer(tiny_config(workdir), resume=False)
+    bcast = RecordingBroadcast()
+    _patch_topology(monkeypatch, count=2, index=0, bcast=bcast)
+    resumed._restore_synchronized()
+    np.testing.assert_array_equal(bcast.calls[0], np.array([1, 0], np.int32))
+    assert resumed.start_epoch == 0
+    assert len(bcast.calls) == 2
