@@ -1,0 +1,202 @@
+"""Convergence A/B harness: stem factors and gradient-codec modes.
+
+Trains the flagship U-Net on synthetic Vaihingen-like 512² tiles with the
+WHOLE dataset device-resident (one upload, on-device batch gather), so the
+comparison measures optimization quality, not host-link bandwidth — the
+axon tunnel uploads ~3 MB/tile, which would otherwise dominate 30-epoch
+runs (~400 MB/epoch).
+
+Two studies, both VERDICT r1 items:
+- ``--stems 2,4``: does the faster stem_factor=4 pyramid (the headline
+  bench config) match stem_factor=2 quality?
+- ``--modes none,int8,float16``: the reference's research contribution is
+  lossy gradient compression (кластер.py:255-557); this records what the
+  codec costs in end-state mIoU vs the uncompressed control.
+
+Writes one JSONL per variant under --outdir plus a summary table to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ddlpc_tpu.config import (
+    CompressionConfig,
+    DataConfig,
+    ExperimentConfig,
+    ModelConfig,
+    ParallelConfig,
+    TrainConfig,
+)
+from ddlpc_tpu.data import SyntheticTiles, train_test_split
+from ddlpc_tpu.models import build_model_from_experiment
+from ddlpc_tpu.ops.metrics import accuracy_from_confusion, mean_iou
+from ddlpc_tpu.parallel.mesh import make_mesh
+from ddlpc_tpu.parallel.train_step import (
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+)
+from ddlpc_tpu.train.optim import build_optimizer
+
+
+def run_variant(
+    tag: str,
+    stem_factor: int,
+    mode: str,
+    epochs: int,
+    outdir: str,
+    image_size=(512, 512),
+    num_tiles=127,
+    test_split=30,
+    micro_batch=8,
+    sync_period=4,
+    seed=0,
+) -> dict:
+    cfg = ExperimentConfig(
+        model=ModelConfig(
+            width_divisor=2,
+            num_classes=6,
+            stem="s2d" if stem_factor > 1 else "none",
+            stem_factor=max(stem_factor, 2),
+        ),
+        data=DataConfig(image_size=image_size),
+        train=TrainConfig(
+            micro_batch_size=micro_batch,
+            sync_period=sync_period,
+            learning_rate=1e-3,
+            seed=seed,
+        ),
+        parallel=ParallelConfig(),
+        compression=CompressionConfig(mode=mode),
+    )
+    mesh = make_mesh(cfg.parallel)
+    n_dev = mesh.shape["data"]
+    model = build_model_from_experiment(cfg)
+    tx = build_optimizer(cfg.train)
+    h, w = image_size
+    state = create_train_state(model, tx, jax.random.key(seed), (1, h, w, 3))
+    state = jax.device_put(state, NamedSharding(mesh, P()))
+    step = make_train_step(model, tx, mesh, cfg.compression)
+    eval_step = make_eval_step(model, mesh, cfg.model.num_classes)
+
+    train_ds, test_ds = train_test_split(
+        SyntheticTiles(num_tiles, image_size, seed=1), test_split
+    )
+    repl = NamedSharding(mesh, P())
+    # One upload; every batch is an on-device gather.
+    tr_x = jax.device_put(train_ds.images, repl)
+    tr_y = jax.device_put(train_ds.labels, repl)
+    B = micro_batch * n_dev
+    A = sync_period
+    super_batch = B * A
+    n = len(train_ds)
+    batch_sh = NamedSharding(mesh, P(None, "data"))
+    ev_sh = NamedSharding(mesh, P("data"))
+
+    @jax.jit
+    def gather_batch(x, y, idx):
+        bx = jnp.take(x, idx, axis=0).reshape(A, B, h, w, 3)
+        by = jnp.take(y, idx, axis=0).reshape(A, B, h, w)
+        return (
+            jax.lax.with_sharding_constraint(bx, batch_sh),
+            jax.lax.with_sharding_constraint(by, batch_sh),
+        )
+
+    # Eval tiles resident too; batch = one multiple of the mesh.
+    ev_b = max(n_dev, min(len(test_ds), 8 * n_dev) // n_dev * n_dev)
+    pad = (-len(test_ds)) % ev_b
+    ev_x = np.concatenate([test_ds.images, test_ds.images[:pad]]) if pad else test_ds.images
+    ev_y = np.concatenate(
+        [test_ds.labels, np.full((pad, h, w), -1, np.int32)]
+    ) if pad else test_ds.labels
+    ev_x_d = jax.device_put(ev_x, repl)
+    ev_y_d = jax.device_put(ev_y, repl)
+
+    @jax.jit
+    def ev_slice(x, y, start):
+        bx = jax.lax.dynamic_slice_in_dim(x, start, ev_b)
+        by = jax.lax.dynamic_slice_in_dim(y, start, ev_b)
+        return (
+            jax.lax.with_sharding_constraint(bx, ev_sh),
+            jax.lax.with_sharding_constraint(by, ev_sh),
+        )
+
+    def evaluate():
+        cm = np.zeros((cfg.model.num_classes,) * 2, np.float64)
+        for start in range(0, len(ev_x), ev_b):
+            bx, by = ev_slice(ev_x_d, ev_y_d, start)
+            out = eval_step(state, bx, by)
+            cm += np.asarray(out["confusion"], np.float64)
+        return {
+            "val_miou": float(mean_iou(cm)),
+            "val_pixel_acc": float(accuracy_from_confusion(cm)),
+        }
+
+    os.makedirs(outdir, exist_ok=True)
+    log_path = os.path.join(outdir, f"{tag}.jsonl")
+    rng = np.random.default_rng(seed)
+    rec = {}
+    with open(log_path, "w") as log:
+        for epoch in range(epochs):
+            perm = rng.permutation(n)
+            perm = np.resize(perm, -(-n // super_batch) * super_batch)
+            losses = []
+            for s in range(0, len(perm), super_batch):
+                idx = jnp.asarray(perm[s : s + super_batch])
+                bx, by = gather_batch(tr_x, tr_y, idx)
+                state, m = step(state, bx, by)
+                losses.append(m["loss"])
+            rec = {
+                "tag": tag,
+                "epoch": epoch,
+                "loss": float(np.mean([float(l) for l in losses])),
+            }
+            if (epoch + 1) % 5 == 0 or epoch == epochs - 1:
+                rec.update(evaluate())
+            log.write(json.dumps(rec) + "\n")
+            log.flush()
+    return rec
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--stems", default="", help="comma list, e.g. 2,4")
+    p.add_argument("--modes", default="", help="comma list, e.g. none,int8,float16")
+    p.add_argument("--stem-for-modes", type=int, default=4)
+    p.add_argument("--epochs", type=int, default=40)
+    p.add_argument("--outdir", default="runs/convergence_ab")
+    args = p.parse_args()
+
+    results = []
+    for sf in [int(s) for s in args.stems.split(",") if s]:
+        results.append(
+            run_variant(
+                f"stem{sf}_fp16", sf, "float16", args.epochs, args.outdir
+            )
+        )
+        print(json.dumps(results[-1]))
+    for mode in [m for m in args.modes.split(",") if m]:
+        results.append(
+            run_variant(
+                f"mode_{mode}_stem{args.stem_for_modes}",
+                args.stem_for_modes,
+                mode,
+                args.epochs,
+                args.outdir,
+            )
+        )
+        print(json.dumps(results[-1]))
+    with open(os.path.join(args.outdir, "summary.json"), "w") as f:
+        json.dump(results, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()
