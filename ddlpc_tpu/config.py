@@ -70,6 +70,11 @@ class DataConfig:
     # the held-out scenes (capped at ``test_split`` tiles).
     crops_per_epoch: int = 0
     test_split_scenes: int = 1  # scenes held out for eval in crop mode
+    # Upload the whole train set to HBM once and gather batches on device
+    # (single-process, fixed-tile datasets that fit HBM — ISPRS scale is
+    # ~0.5 GB).  Removes the per-epoch host→device re-upload, which on slow
+    # host links costs more than the training compute (docs/PERF.md).
+    device_cache: bool = False
 
 
 @dataclass(frozen=True)

@@ -17,4 +17,8 @@ from ddlpc_tpu.data.datasets import (  # noqa: F401
     load_scene_dir,
     train_test_split,
 )
-from ddlpc_tpu.data.loader import ShardedLoader, make_global_array  # noqa: F401
+from ddlpc_tpu.data.loader import (  # noqa: F401
+    DeviceCachedLoader,
+    ShardedLoader,
+    make_global_array,
+)

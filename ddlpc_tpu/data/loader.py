@@ -23,6 +23,7 @@ import threading
 from typing import Iterator, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -38,7 +39,43 @@ def make_global_array(
     )
 
 
-class ShardedLoader:
+class _EpochSampler:
+    """Shared sampling core: seeded per-epoch permutation with wrap-fill.
+
+    Both loaders derive their epoch order from here so the transport choice
+    (host-sharded upload vs device-resident gather) can never change WHICH
+    tiles a run trains on.
+    """
+
+    ds: "TileDataset"
+    super_batch: int
+    shuffle: bool
+    seed: int
+    tail: str = "wrap"
+
+    def __len__(self) -> int:
+        if self.tail == "wrap":
+            return -(-len(self.ds) // self.super_batch)
+        return len(self.ds) // self.super_batch
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = int(epoch)
+        self.ds.set_epoch(epoch)
+
+    def _epoch_indices(self) -> np.ndarray:
+        idx = np.arange(len(self.ds))
+        if self.shuffle:
+            # Same permutation on every process (shared seed), like
+            # DistributedSampler.set_epoch; the per-process slice differs.
+            np.random.default_rng(self.seed + self._epoch).shuffle(idx)
+        if self.tail == "wrap":
+            # Pad to a whole number of super-batches by wrapping, so every
+            # tile appears at least once and shapes stay static for XLA.
+            idx = np.resize(idx, len(self) * self.super_batch)
+        return idx
+
+
+class ShardedLoader(_EpochSampler):
     """Iterates (images, labels) super-batches, sharded over the mesh.
 
     One "item" feeds one optimizer step: ``sync_period`` micro-batches of
@@ -107,27 +144,6 @@ class ShardedLoader:
             )
         self.image_spec = P(None, data_axis, space_axis)  # [A, B, H, W, C]
         self.label_spec = P(None, data_axis, space_axis)  # [A, B, H, W]
-
-    def __len__(self) -> int:
-        if self.tail == "wrap":
-            return -(-len(self.ds) // self.super_batch)
-        return len(self.ds) // self.super_batch
-
-    def set_epoch(self, epoch: int) -> None:
-        self._epoch = epoch
-        self.ds.set_epoch(epoch)
-
-    def _epoch_indices(self) -> np.ndarray:
-        idx = np.arange(len(self.ds))
-        if self.shuffle:
-            # Same permutation on every process (shared seed), like
-            # DistributedSampler.set_epoch; the per-process slice differs.
-            np.random.default_rng(self.seed + self._epoch).shuffle(idx)
-        if self.tail == "wrap":
-            # Pad to a whole number of super-batches by wrapping, so every
-            # tile appears at least once and shapes stay static for XLA.
-            idx = np.resize(idx, len(self) * self.super_batch)
-        return idx
 
     def _local_batches(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         idx = self._epoch_indices()
@@ -203,6 +219,86 @@ class ShardedLoader:
                 except queue.Empty:
                     break
             t.join()
+
+
+class DeviceCachedLoader(_EpochSampler):
+    """Whole-dataset-on-HBM loader: upload once, gather batches on device.
+
+    For corpora that fit HBM (ISPRS scale: 127 × 512²×3 fp32 ≈ 400 MB) the
+    per-epoch host→device re-upload is the bottleneck — on a tunneled or
+    DCN-attached host it can be 30-60× the step's compute time.  This
+    loader uploads the tile arrays ONCE (replicated), then every
+    super-batch is a compiled on-device ``take`` resharded onto the data
+    axis; epochs cost zero host-link bytes.
+
+    Same iterator contract as :class:`ShardedLoader` (wrap-fill epochs,
+    seeded shared permutation, ``set_epoch``).  Single-process only: with
+    multiple hosts each process holds only its slice of the data, so
+    replicated upload would need a cross-host gather — use ShardedLoader
+    there (its prefetch overlaps the uploads instead).
+    """
+
+    def __init__(
+        self,
+        dataset: TileDataset,
+        mesh: Mesh,
+        global_micro_batch: int,
+        sync_period: int = 1,
+        shuffle: bool = True,
+        seed: int = 0,
+        data_axis: str = "data",
+        space_axis: Optional[str] = None,
+    ):
+        if jax.process_count() != 1:
+            raise ValueError(
+                "DeviceCachedLoader is single-process (replicated upload); "
+                "use ShardedLoader for multi-host runs"
+            )
+        if not isinstance(dataset, TileDataset):
+            raise ValueError(
+                "DeviceCachedLoader needs a fixed-tile TileDataset (crop "
+                "datasets materialize tiles on the host per epoch)"
+            )
+        if len(dataset) == 0:
+            raise ValueError("dataset is empty")
+        data_size = mesh.shape.get(data_axis, 1)
+        if global_micro_batch % data_size:
+            raise ValueError(
+                f"global_micro_batch={global_micro_batch} must be divisible "
+                f"by the '{data_axis}' mesh axis size {data_size}"
+            )
+        self.ds = dataset
+        self.mesh = mesh
+        self.global_micro_batch = global_micro_batch
+        self.sync_period = sync_period
+        self.shuffle = shuffle
+        self.seed = seed
+        self.tail = "wrap"
+        self.super_batch = global_micro_batch * sync_period
+        self._epoch = 0
+        repl = NamedSharding(mesh, P())
+        self._images = jax.device_put(dataset.images, repl)
+        self._labels = jax.device_put(dataset.labels, repl)
+        batch_sh = NamedSharding(mesh, P(None, data_axis, space_axis))
+        A, B = sync_period, global_micro_batch
+        h, w, c = dataset.image_shape
+
+        @jax.jit
+        def gather(images, labels, idx):
+            bx = jnp.take(images, idx, axis=0).reshape(A, B, h, w, c)
+            by = jnp.take(labels, idx, axis=0).reshape(A, B, h, w)
+            return (
+                jax.lax.with_sharding_constraint(bx, batch_sh),
+                jax.lax.with_sharding_constraint(by, batch_sh),
+            )
+
+        self._gather = gather
+
+    def __iter__(self):
+        idx = self._epoch_indices()
+        for start in range(0, len(idx), self.super_batch):
+            chunk = jnp.asarray(idx[start : start + self.super_batch])
+            yield self._gather(self._images, self._labels, chunk)
 
 
 def eval_batches(

@@ -23,7 +23,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ddlpc_tpu.config import ExperimentConfig
 from ddlpc_tpu.data import ShardedLoader, build_dataset
-from ddlpc_tpu.data.loader import eval_batches
+from ddlpc_tpu.data.loader import DeviceCachedLoader, eval_batches
 from ddlpc_tpu.models import build_model_from_experiment
 from ddlpc_tpu.ops.metrics import accuracy_from_confusion, mean_iou
 from ddlpc_tpu.parallel.mesh import initialize_distributed, make_mesh
@@ -118,7 +118,10 @@ class Trainer:
             )
         self.predict = make_predict_fn(self.model)
 
-        self.loader = ShardedLoader(
+        loader_cls = (
+            DeviceCachedLoader if cfg.data.device_cache else ShardedLoader
+        )
+        self.loader = loader_cls(
             self.train_ds,
             self.mesh,
             global_micro_batch=self.global_micro_batch,

@@ -404,6 +404,65 @@ def test_crop_loader_end_to_end(mesh):
     assert not all(np.array_equal(x, z) for x, z in zip(a, b))
 
 
+def test_device_cached_loader_matches_sharded(mesh):
+    """DeviceCachedLoader must serve byte-identical epochs to ShardedLoader
+    (same permutation, same wrap-fill) — only the transport differs."""
+    from ddlpc_tpu.data import DeviceCachedLoader
+
+    ds = SyntheticTiles(num_tiles=33, image_size=(8, 8), seed=4)
+    kw = dict(global_micro_batch=8, sync_period=2, shuffle=True, seed=5)
+    host = ShardedLoader(ds, mesh, prefetch=0, **kw)
+    dev = DeviceCachedLoader(ds, mesh, **kw)
+    assert len(host) == len(dev) == 3
+    for epoch in (0, 1):
+        host.set_epoch(epoch)
+        dev.set_epoch(epoch)
+        for (hx, hy), (dx, dy) in zip(host, dev):
+            np.testing.assert_array_equal(np.asarray(hx), np.asarray(dx))
+            np.testing.assert_array_equal(np.asarray(hy), np.asarray(dy))
+            # Semantic sharding check (trailing-None normalization varies).
+            from jax.sharding import NamedSharding
+
+            assert dx.sharding.is_equivalent_to(
+                NamedSharding(mesh, P(None, "data", None)), dx.ndim
+            )
+
+
+def test_device_cached_loader_rejects_crop_dataset(mesh):
+    from ddlpc_tpu.data import CropDataset, DeviceCachedLoader
+
+    ds = CropDataset(_toy_scenes(), crop_size=(8, 8), crops_per_epoch=16)
+    with pytest.raises(ValueError, match="TileDataset"):
+        DeviceCachedLoader(ds, mesh, global_micro_batch=8)
+
+
+def test_trainer_with_device_cache(tmp_path, mesh):
+    from ddlpc_tpu.config import (
+        ExperimentConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+    from ddlpc_tpu.data.loader import DeviceCachedLoader
+    from ddlpc_tpu.train.trainer import Trainer
+
+    cfg = ExperimentConfig(
+        model=ModelConfig(features=(4, 8), bottleneck_features=8, num_classes=4),
+        data=DataConfig(
+            dataset="synthetic", image_size=(16, 16), synthetic_len=24,
+            test_split=4, num_classes=4, device_cache=True,
+        ),
+        train=TrainConfig(
+            epochs=1, micro_batch_size=1, sync_period=2,
+            dump_images_per_epoch=0,
+        ),
+        workdir=str(tmp_path),
+    )
+    trainer = Trainer(cfg, resume=False)
+    assert isinstance(trainer.loader, DeviceCachedLoader)
+    rec = trainer.fit()
+    assert np.isfinite(rec["loss"]) and "val_miou" in rec
+
+
 def test_eval_batches_padding_masks_labels(mesh):
     ds = SyntheticTiles(num_tiles=10, image_size=(8, 8))
     batches = list(eval_batches(ds, mesh, global_batch=8))
