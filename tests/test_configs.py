@@ -36,7 +36,10 @@ def _shrunk(cfg: ExperimentConfig, workdir: str) -> ExperimentConfig:
     if data == -1 or data * space > n_dev:
         data = n_dev // space
     h, w = cfg.data.image_size
-    scale = max(h // 64, 1)
+    # A factor-4 s2d stem divides resolution by 4 before the 5-level
+    # pyramid, so the shrunk tile must keep min dim ≥ 4·2⁵ = 128.
+    min_dim = 128 if cfg.model.stem == "s2d" else 64
+    scale = max(h // min_dim, 1)
     return cfg.replace(
         model=dataclasses.replace(
             cfg.model,
@@ -83,4 +86,5 @@ def test_config_trains_one_epoch(path, tmp_path):
 
 
 def test_config_files_exist():
-    assert len(CONFIG_FILES) == 5, CONFIG_FILES
+    # The five BASELINE parity configs plus the TPU-first flagship.
+    assert len(CONFIG_FILES) == 6, CONFIG_FILES
