@@ -70,6 +70,11 @@ class DataConfig:
     # the held-out scenes (capped at ``test_split`` tiles).
     crops_per_epoch: int = 0
     test_split_scenes: int = 1  # scenes held out for eval in crop mode
+    # Dihedral-group augmentation (4 rotations × optional flip) on training
+    # tiles — standard for orientation-free aerial imagery; the reference
+    # has none.  Requires square tiles; incompatible with device_cache
+    # (augmentation happens in the host gather path).
+    augment: bool = False
     # Upload the whole train set to HBM once and gather batches on device
     # (single-process, fixed-tile datasets that fit HBM — ISPRS scale is
     # ~0.5 GB).  Removes the per-epoch host→device re-upload, which on slow

@@ -207,6 +207,72 @@ class CropDataset:
         return (*self.crop_size, self.scenes[0][0].shape[-1])
 
 
+class DihedralAugment:
+    """Epoch-deterministic dihedral-group augmentation wrapper.
+
+    Aerial tiles are orientation-free, so the standard augmentation is the
+    8-element dihedral group (4 rotations × optional flip) applied jointly
+    to image and mask.  The reference trains with no augmentation at all;
+    this is opt-in (``DataConfig.augment``).  The transform for (epoch,
+    index) is a pure function of the seed, so every process computing the
+    same epoch applies identical augmentations — the property the sharded
+    loader's shared permutation requires.
+    """
+
+    def __init__(self, ds, seed: int = 0):
+        self.ds = ds
+        self.seed = seed
+        self._epoch = 0
+        self._ks: Optional[np.ndarray] = None
+
+    def __len__(self) -> int:
+        return len(self.ds)
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = int(epoch)
+        self._ks = None
+        self.ds.set_epoch(epoch)
+
+    @property
+    def image_shape(self):
+        h, w, c = self.ds.image_shape
+        if h != w:
+            raise ValueError(
+                f"dihedral augmentation needs square tiles, got {(h, w)} "
+                f"(90° rotations change the shape otherwise)"
+            )
+        return (h, w, c)
+
+    def _epoch_ks(self) -> np.ndarray:
+        """One transform draw per dataset index per epoch (not per gather
+        position), so the same tile gets the same transform wherever it
+        lands in the epoch; cached like CropDataset._crop_plan."""
+        if self._ks is None:
+            rng = np.random.default_rng((self.seed, self._epoch, 0xD1))
+            self._ks = rng.integers(0, 8, size=len(self.ds))
+        return self._ks
+
+    def gather(self, indices: np.ndarray):
+        self.image_shape  # square-tile validation
+        # Both underlying gather()s return freshly-allocated arrays, so
+        # in-place transformation is safe without a defensive copy.
+        imgs, labs = self.ds.gather(indices)
+        ks = self._epoch_ks()
+        for out, idx in enumerate(np.asarray(indices, np.int64)):
+            k = ks[idx]
+            rot, flip = int(k % 4), bool(k >= 4)
+            img, lab = imgs[out], labs[out]
+            if rot:
+                img = np.rot90(img, rot, axes=(0, 1))
+                lab = np.rot90(lab, rot, axes=(0, 1))
+            if flip:
+                img = img[:, ::-1]
+                lab = lab[:, ::-1]
+            imgs[out] = img
+            labs[out] = lab
+        return imgs, labs
+
+
 def grid_tiles(
     scenes: "list[Tuple[np.ndarray, np.ndarray]]",
     tile_size: Tuple[int, int],
@@ -452,6 +518,8 @@ def build_dataset(cfg: DataConfig):
             crops_per_epoch=cfg.crops_per_epoch,
             seed=cfg.seed,
         )
+        if cfg.augment:
+            train = DihedralAugment(train, seed=cfg.seed)
         if k:
             test = grid_tiles(
                 scenes[len(scenes) - k :],
@@ -474,4 +542,7 @@ def build_dataset(cfg: DataConfig):
             num_classes=cfg.num_classes,
             seed=cfg.seed,
         )
-    return train_test_split(ds, cfg.test_split)
+    train, test = train_test_split(ds, cfg.test_split)
+    if cfg.augment:
+        train = DihedralAugment(train, seed=cfg.seed)
+    return train, test

@@ -25,7 +25,11 @@ from ddlpc_tpu.config import ExperimentConfig
 from ddlpc_tpu.data import ShardedLoader, build_dataset
 from ddlpc_tpu.data.loader import DeviceCachedLoader, eval_batches
 from ddlpc_tpu.models import build_model_from_experiment
-from ddlpc_tpu.ops.metrics import accuracy_from_confusion, mean_iou
+from ddlpc_tpu.ops.metrics import (
+    accuracy_from_confusion,
+    iou_per_class,
+    mean_iou,
+)
 from ddlpc_tpu.parallel.mesh import initialize_distributed, make_mesh
 from ddlpc_tpu.parallel.train_step import (
     create_train_state,
@@ -62,6 +66,12 @@ class Trainer:
                 f"model.num_classes={cfg.model.num_classes} != "
                 f"data.num_classes={cfg.data.num_classes}: the loss would "
                 f"silently clip out-of-range labels and mIoU would drop them"
+            )
+        if cfg.data.device_cache and cfg.data.augment:
+            raise ValueError(
+                "data.device_cache and data.augment are mutually exclusive: "
+                "augmentation runs in the host gather path that the device "
+                "cache bypasses"
             )
         self.mesh = make_mesh(cfg.parallel)
         data_size = self.mesh.shape[cfg.parallel.data_axis_name]
@@ -243,6 +253,9 @@ class Trainer:
             "val_loss": loss_sum / max(pixels, 1.0),
             "val_pixel_acc": float(accuracy_from_confusion(cm)),
             "val_miou": float(mean_iou(cm)),
+            "val_iou_per_class": [
+                round(float(v), 4) for v in np.asarray(iou_per_class(cm))
+            ],
         }
 
     def dump_images(self, epoch: int) -> None:

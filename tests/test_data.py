@@ -463,6 +463,65 @@ def test_trainer_with_device_cache(tmp_path, mesh):
     assert np.isfinite(rec["loss"]) and "val_miou" in rec
 
 
+def test_dihedral_augment_joint_and_deterministic():
+    """Image and mask get the SAME transform (anything else silently
+    mislabels), transforms are epoch-deterministic, and all 8 dihedral
+    elements actually occur."""
+    from ddlpc_tpu.data import DihedralAugment
+
+    ds = SyntheticTiles(num_tiles=64, image_size=(16, 16), num_classes=4, seed=7)
+    aug = DihedralAugment(ds, seed=1)
+    assert len(aug) == 64 and aug.image_shape == (16, 16, 3)
+    idx = np.arange(64)
+    imgs, labs = aug.gather(idx)
+    imgs2, labs2 = aug.gather(idx)
+    np.testing.assert_array_equal(imgs, imgs2)  # same epoch → identical
+    aug.set_epoch(1)
+    imgs3, _ = aug.gather(idx)
+    assert not np.array_equal(imgs, imgs3)  # re-randomized per epoch
+
+    base_imgs, base_labs = ds.gather(idx)
+    seen = set()
+    for i in range(64):
+        found = None
+        for k in range(8):
+            rot, flip = k % 4, k >= 4
+            img = np.rot90(base_imgs[i], rot, axes=(0, 1))
+            lab = np.rot90(base_labs[i], rot, axes=(0, 1))
+            if flip:
+                img, lab = img[:, ::-1], lab[:, ::-1]
+            if np.array_equal(imgs3[i], img):
+                # The mask must carry the SAME dihedral element.
+                np.testing.assert_array_equal(
+                    aug.gather(np.array([i]))[1][0], lab
+                )
+                found = k
+                break
+        assert found is not None  # every output is a dihedral of the input
+        seen.add(found)
+    assert len(seen) >= 6  # with 64 draws, (nearly) all 8 elements occur
+
+
+def test_dihedral_augment_rejects_nonsquare():
+    from ddlpc_tpu.data import DihedralAugment
+
+    ds = SyntheticTiles(num_tiles=2, image_size=(16, 32))
+    with pytest.raises(ValueError, match="square"):
+        DihedralAugment(ds).gather(np.array([0]))
+
+
+def test_build_dataset_augment_wraps_train_only():
+    from ddlpc_tpu.data import DihedralAugment
+
+    cfg = DataConfig(
+        dataset="synthetic", image_size=(16, 16), synthetic_len=10,
+        test_split=2, augment=True,
+    )
+    train, test = build_dataset(cfg)
+    assert isinstance(train, DihedralAugment)
+    assert isinstance(test, TileDataset)  # eval tiles unaugmented
+
+
 def test_eval_batches_padding_masks_labels(mesh):
     ds = SyntheticTiles(num_tiles=10, image_size=(8, 8))
     batches = list(eval_batches(ds, mesh, global_batch=8))
