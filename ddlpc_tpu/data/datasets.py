@@ -137,11 +137,12 @@ class CropDataset:
                     f"scene {i}: image {img.shape[:2]} != label {lab.shape[:2]}"
                 )
             if img.shape[0] < ch or img.shape[1] < cw:
-                # Zero-pad undersized scenes up to one crop (reference pads
-                # nothing but also never checks; failing silently mislabels).
+                # Pad undersized scenes up to one crop (reference pads
+                # nothing but also never checks; failing silently
+                # mislabels).  Labels pad with void (-1), not class 0.
                 pad_h, pad_w = max(ch - img.shape[0], 0), max(cw - img.shape[1], 0)
                 img = np.pad(img, ((0, pad_h), (0, pad_w), (0, 0)))
-                lab = np.pad(lab, ((0, pad_h), (0, pad_w)))
+                lab = np.pad(lab, ((0, pad_h), (0, pad_w)), constant_values=-1)
             self.scenes.append(
                 (
                     np.ascontiguousarray(img, np.float32),
@@ -383,8 +384,13 @@ def load_tile_dir(
         images.append(load_image_file(img_by_stem[s], size, normalize=normalize))
         lab = lab[: size[0], : size[1]]
         if lab.shape != size:
+            # Void (-1), not class 0: padded pixels must not train or score
+            # as the first class (the loss/metrics/confusion paths all
+            # ignore -1).
             lab = np.pad(
-                lab, ((0, size[0] - lab.shape[0]), (0, size[1] - lab.shape[1]))
+                lab,
+                ((0, size[0] - lab.shape[0]), (0, size[1] - lab.shape[1])),
+                constant_values=-1,
             )
         labels.append(lab)
     return TileDataset(np.stack(images), np.stack(labels).astype(np.int32))

@@ -89,8 +89,16 @@ def _loss_and_metrics(
     else:
         logits = model.apply(variables, images, train=False)
         new_stats = batch_stats
-    loss = softmax_cross_entropy(logits, labels)
-    acc = pixel_accuracy(logits, labels)
+    # -1 marks void/ignored pixels (e.g. Cityscapes' unlabeled classes,
+    # scripts/prepare_cityscapes.py); they contribute neither loss nor
+    # accuracy.  Datasets without voids have no -1 labels, so this is a
+    # no-op for them.  The mean is per-micro-batch over ITS valid pixels
+    # (then gradients average equally across micro-batches/replicas) —
+    # deliberately the torch CrossEntropyLoss(reduction='mean') + DDP
+    # semantics the reference inherits, not a globally pixel-weighted mean;
+    # the eval path (softmax_cross_entropy_sum) is globally weighted.
+    loss = softmax_cross_entropy(logits, labels, ignore_index=-1)
+    acc = pixel_accuracy(logits, labels, ignore_index=-1)
     return loss, (new_stats, acc)
 
 

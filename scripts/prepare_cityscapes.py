@@ -1,0 +1,114 @@
+"""Convert a Cityscapes checkout into this framework's tile-directory format.
+
+Cityscapes ships ``leftImg8bit/<split>/<city>/*_leftImg8bit.png`` images and
+``gtFine/<split>/<city>/*_gtFine_labelIds.png`` masks whose values are the
+33 raw label ids; training uses the standard 19 "trainId" classes with
+everything else void.  This tool walks a split, maps labelIds → trainIds
+(void → -1, which the loss/metrics/confusion paths all ignore), optionally
+downscales (BASELINE config 5 trains 1024×512 halves of the 2048×1024
+frames), and writes ``<stem>.png`` + ``<stem>.npy`` pairs that
+``load_tile_dir`` / ``load_scene_dir`` consume directly:
+
+    python scripts/prepare_cityscapes.py --root /data/cityscapes \
+        --split train --out /data/cs_train --downscale 2
+
+The reference has no counterpart (its only dataset is a prepared Vaihingen
+tile folder); this closes the gap for BASELINE config 5.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+# labelId -> trainId for the standard 19-class Cityscapes benchmark
+# (Cordts et al. 2016, the 'trainId' column of the official label table);
+# every labelId not listed is void.
+_TRAIN_IDS = {
+    7: 0,  # road
+    8: 1,  # sidewalk
+    11: 2,  # building
+    12: 3,  # wall
+    13: 4,  # fence
+    17: 5,  # pole
+    19: 6,  # traffic light
+    20: 7,  # traffic sign
+    21: 8,  # vegetation
+    22: 9,  # terrain
+    23: 10,  # sky
+    24: 11,  # person
+    25: 12,  # rider
+    26: 13,  # car
+    27: 14,  # truck
+    28: 15,  # bus
+    31: 16,  # train
+    32: 17,  # motorcycle
+    33: 18,  # bicycle
+}
+VOID = -1
+
+
+def labelids_to_trainids(label_ids: np.ndarray) -> np.ndarray:
+    """[H, W] raw labelIds → int32 trainIds with void = -1."""
+    lut = np.full(256, VOID, np.int32)
+    for label_id, train_id in _TRAIN_IDS.items():
+        lut[label_id] = train_id
+    return lut[label_ids.astype(np.uint8)]
+
+
+def convert_split(
+    root: str, split: str, out_dir: str, downscale: int = 1, limit: int = 0
+) -> int:
+    from PIL import Image
+
+    img_root = os.path.join(root, "leftImg8bit", split)
+    gt_root = os.path.join(root, "gtFine", split)
+    if not os.path.isdir(img_root):
+        raise FileNotFoundError(f"no such split: {img_root}")
+    os.makedirs(out_dir, exist_ok=True)
+    n = 0
+    for city in sorted(os.listdir(img_root)):
+        city_dir = os.path.join(img_root, city)
+        if not os.path.isdir(city_dir):
+            continue
+        for name in sorted(os.listdir(city_dir)):
+            if not name.endswith("_leftImg8bit.png"):
+                continue
+            stem = name[: -len("_leftImg8bit.png")]
+            gt_path = os.path.join(gt_root, city, f"{stem}_gtFine_labelIds.png")
+            if not os.path.exists(gt_path):
+                raise FileNotFoundError(f"missing mask for {stem}: {gt_path}")
+            img = Image.open(os.path.join(city_dir, name)).convert("RGB")
+            mask = Image.open(gt_path)
+            if downscale > 1:
+                w, h = img.size
+                img = img.resize((w // downscale, h // downscale), Image.BILINEAR)
+                # NEAREST for masks: interpolating label ids invents classes.
+                mask = mask.resize((w // downscale, h // downscale), Image.NEAREST)
+            img.save(os.path.join(out_dir, f"{stem}.png"))
+            np.save(
+                os.path.join(out_dir, f"{stem}.npy"),
+                labelids_to_trainids(np.asarray(mask)),
+            )
+            n += 1
+            if limit and n >= limit:
+                return n
+    return n
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--root", required=True, help="Cityscapes checkout root")
+    p.add_argument("--split", default="train", choices=["train", "val", "test"])
+    p.add_argument("--out", required=True, help="output tile directory")
+    p.add_argument("--downscale", type=int, default=2)
+    p.add_argument("--limit", type=int, default=0, help="stop after N frames")
+    args = p.parse_args()
+    n = convert_split(args.root, args.split, args.out, args.downscale, args.limit)
+    print(f"wrote {n} (image, trainId-mask) pairs to {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,133 @@
+"""Cityscapes preparation + void-label training path (BASELINE config 5).
+
+The reference only ever consumed a pre-tiled Vaihingen folder; Cityscapes
+needs labelId→trainId mapping with void pixels, and the train step must
+actually ignore those pixels (loss, accuracy, confusion) rather than clip
+them into class 0.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+from prepare_cityscapes import (  # noqa: E402
+    _TRAIN_IDS,
+    convert_split,
+    labelids_to_trainids,
+)
+
+
+def test_labelid_mapping_table():
+    ids = np.array([[7, 8, 11], [0, 255, 33]], np.uint8)
+    out = labelids_to_trainids(ids)
+    np.testing.assert_array_equal(out, [[0, 1, 2], [-1, -1, 18]])
+    assert out.dtype == np.int32
+    assert sorted(_TRAIN_IDS.values()) == list(range(19))
+
+
+def _fake_cityscapes(root, frames=3, size=(64, 128)):
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    h, w = size
+    for i in range(frames):
+        city = "testcity"
+        img_dir = os.path.join(root, "leftImg8bit", "train", city)
+        gt_dir = os.path.join(root, "gtFine", "train", city)
+        os.makedirs(img_dir, exist_ok=True)
+        os.makedirs(gt_dir, exist_ok=True)
+        stem = f"{city}_{i:06d}_000019"
+        Image.fromarray(
+            rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+        ).save(os.path.join(img_dir, f"{stem}_leftImg8bit.png"))
+        # Raw labelIds incl. voids (0) and mapped classes.
+        label_ids = rng.choice(
+            [0, 7, 8, 11, 21, 23, 26], size=(h, w)
+        ).astype(np.uint8)
+        Image.fromarray(label_ids, mode="L").save(
+            os.path.join(gt_dir, f"{stem}_gtFine_labelIds.png")
+        )
+
+
+def test_convert_split_and_load(tmp_path):
+    from ddlpc_tpu.data.datasets import load_tile_dir
+
+    root = str(tmp_path / "cs")
+    out = str(tmp_path / "tiles")
+    _fake_cityscapes(root)
+    n = convert_split(root, "train", out, downscale=2)
+    assert n == 3
+    ds = load_tile_dir(out)
+    assert ds.images.shape == (3, 32, 64, 3)  # downscaled by 2
+    labs = ds.labels
+    assert labs.min() == -1  # voids preserved
+    assert set(np.unique(labs)) <= ({-1} | set(range(19)))
+
+
+def test_training_ignores_void_pixels():
+    """Gradients and metrics must be independent of what void pixels 'say':
+    two batches identical except for garbage logits targets at void
+    positions produce identical losses; an all-void batch yields zero
+    gradient."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddlpc_tpu.ops.losses import softmax_cross_entropy
+    from ddlpc_tpu.ops.metrics import pixel_accuracy
+
+    k = jax.random.PRNGKey(0)
+    logits = jax.random.normal(k, (2, 8, 8, 19))
+    labels = jax.random.randint(k, (2, 8, 8), 0, 19)
+    voided = labels.at[:, :4].set(-1)
+    l1 = softmax_cross_entropy(logits, voided, ignore_index=-1)
+    # Valid-region-only CE must match CE computed on just the valid half.
+    l2 = softmax_cross_entropy(logits[:, 4:], labels[:, 4:], ignore_index=-1)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    acc = pixel_accuracy(logits, voided, ignore_index=-1)
+    acc2 = pixel_accuracy(logits[:, 4:], labels[:, 4:], ignore_index=-1)
+    np.testing.assert_allclose(float(acc), float(acc2), rtol=1e-6)
+
+    all_void = jnp.full((2, 8, 8), -1)
+    grad = jax.grad(
+        lambda lg: softmax_cross_entropy(lg, all_void, ignore_index=-1)
+    )(logits)
+    np.testing.assert_array_equal(np.asarray(grad), 0.0)
+
+
+def test_train_step_with_void_labels():
+    """End-to-end: a compiled SPMD step on batches containing -1 labels
+    stays finite and steps the optimizer."""
+    import jax
+
+    from ddlpc_tpu.config import (
+        CompressionConfig,
+        ModelConfig,
+        ParallelConfig,
+        TrainConfig,
+    )
+    from ddlpc_tpu.models import build_model
+    from ddlpc_tpu.parallel.mesh import make_mesh
+    from ddlpc_tpu.parallel.train_step import (
+        create_train_state,
+        make_train_step,
+    )
+    from ddlpc_tpu.train.optim import build_optimizer
+
+    mesh = make_mesh(ParallelConfig(data_axis_size=8), jax.devices()[:8])
+    model = build_model(
+        ModelConfig(features=(4, 8), bottleneck_features=8, num_classes=19),
+        norm_axis_name="data",
+    )
+    tx = build_optimizer(TrainConfig())
+    state = create_train_state(model, tx, jax.random.PRNGKey(0), (1, 16, 16, 3))
+    step = make_train_step(model, tx, mesh, CompressionConfig(), donate_state=False)
+    rng = np.random.default_rng(0)
+    images = rng.uniform(0, 1, (2, 8, 16, 16, 3)).astype(np.float32)
+    labels = rng.integers(-1, 19, (2, 8, 16, 16)).astype(np.int32)
+    state, metrics = step(state, images, labels)
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(state.step) == 1

@@ -301,6 +301,8 @@ def test_crop_dataset_pads_undersized_scene():
     imgs, labs = ds.gather(np.array([0, 1]))
     assert imgs.shape == (2, 16, 16, 3)
     assert imgs[0, :8, :8].min() == 1.0 and imgs[0, 8:, 8:].max() == 0.0
+    # Label padding is void (-1), never class 0.
+    assert (labs[0, :8, :8] == 1).all() and (labs[0, 8:, 8:] == -1).all()
 
 
 def test_grid_tiles_deterministic():
