@@ -105,6 +105,11 @@ class TrainConfig:
     keep_checkpoints: int = 3
     eval_every_epochs: int = 1
     dump_images_per_epoch: int = 5  # qualitative PNG triples (кластер.py:785-790)
+    # Rematerialize each micro-batch's forward during backward
+    # (jax.checkpoint): ~1/3 more FLOPs for much lower peak activation HBM,
+    # buying larger micro-batches on memory-bound models (e.g. U-Net++ at
+    # 512² full width).
+    remat: bool = False
     # Epoch index to capture an XLA profiler trace for (into
     # <workdir>/profile); -1 disables.  Replaces the reference's wall-clock
     # print "tracing" (SURVEY §5).

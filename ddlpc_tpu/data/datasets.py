@@ -136,6 +136,8 @@ class CropDataset:
                 raise ValueError(
                     f"scene {i}: image {img.shape[:2]} != label {lab.shape[:2]}"
                 )
+            # int32 before the -1 pad (uint8 would wrap void to 255).
+            lab = np.asarray(lab, np.int32)
             if img.shape[0] < ch or img.shape[1] < cw:
                 # Pad undersized scenes up to one crop (reference pads
                 # nothing but also never checks; failing silently
@@ -379,7 +381,9 @@ def load_tile_dir(
     img_by_stem, npy_by_stem = _paired_files(path)
     images, labels = [], []
     for s in sorted(img_by_stem):
-        lab = np.load(npy_by_stem[s])
+        # int32 BEFORE padding: on a uint8 mask the -1 void pad would wrap
+        # to 255 and silently train as the last class.
+        lab = np.load(npy_by_stem[s]).astype(np.int32)
         size = tuple(image_size) if image_size is not None else lab.shape[:2]
         images.append(load_image_file(img_by_stem[s], size, normalize=normalize))
         lab = lab[: size[0], : size[1]]

@@ -104,6 +104,7 @@ class Trainer:
                 cfg.compression,
                 data_axis=cfg.parallel.data_axis_name,
                 space_axis=space,
+                remat=cfg.train.remat,
             )
             self.eval_step = make_eval_step_gspmd(
                 self.model,
@@ -119,6 +120,7 @@ class Trainer:
                 self.mesh,
                 cfg.compression,
                 data_axis=cfg.parallel.data_axis_name,
+                remat=cfg.train.remat,
             )
             self.eval_step = make_eval_step(
                 self.model,

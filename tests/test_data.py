@@ -338,6 +338,18 @@ def test_load_scene_dir_strict_pairing(tmp_path):
         load_scene_dir(str(tmp_path))
 
 
+def test_load_tile_dir_uint8_mask_pads_void(tmp_path):
+    """uint8 masks must pad with -1, not wrap to 255 (which would train
+    padded pixels as the last class while eval masks them — invisible
+    corruption)."""
+    import imageio.v2 as imageio
+
+    imageio.imwrite(tmp_path / "a.png", np.zeros((8, 8, 3), np.uint8))
+    np.save(tmp_path / "a.npy", np.ones((4, 4), np.uint8))
+    ds = load_tile_dir(str(tmp_path), image_size=(8, 8))
+    assert set(np.unique(ds.labels)) == {-1, 1}
+
+
 def test_load_tile_dir_unmatched_stem_raises(tmp_path):
     import imageio.v2 as imageio
 

@@ -63,6 +63,27 @@ def test_train_step_quantized_runs(mode):
     assert np.isfinite(float(metrics["loss"]))
 
 
+def test_remat_matches_plain_step():
+    """jax.checkpoint must change memory, never math: one remat'd step's
+    params equal the plain step's bitwise-or-close (same program, same
+    inputs; SGD so deltas reflect gradients directly)."""
+    images, labels = _batch(a=2, b=8)
+    _, _, _, state, step = _setup(optimizer="sgd")
+    mesh, model, tx, state_r, _ = _setup(optimizer="sgd")
+    step_r = make_train_step(
+        model, tx, mesh, CompressionConfig(), donate_state=False, remat=True
+    )
+    s_plain, m_plain = step(state, images, labels)
+    s_remat, m_remat = step_r(state_r, images, labels)
+    np.testing.assert_allclose(
+        float(m_plain["loss"]), float(m_remat["loss"]), rtol=1e-6
+    )
+    for a, b in zip(
+        jax.tree.leaves(s_plain.params), jax.tree.leaves(s_remat.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
 def test_dp_matches_single_device():
     """Exact-mean check the reference fails (SURVEY §2.8d 'crooked averaging'):
     8-way DP over a global batch must equal 1-way on the same batch.
