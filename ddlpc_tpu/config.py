@@ -107,8 +107,9 @@ class TrainConfig:
     dump_images_per_epoch: int = 5  # qualitative PNG triples (кластер.py:785-790)
     # Rematerialize each micro-batch's forward during backward
     # (jax.checkpoint): ~1/3 more FLOPs for much lower peak activation HBM,
-    # buying larger micro-batches on memory-bound models (e.g. U-Net++ at
-    # 512² full width).
+    # buying larger micro-batches on memory-bound models.  Known limit: the
+    # U-Net++ dense grid rematerialized at 512² full width crashes the TPU
+    # compiler (graph size); U-Net/DeepLab remat compile and run fine.
     remat: bool = False
     # Epoch index to capture an XLA profiler trace for (into
     # <workdir>/profile); -1 disables.  Replaces the reference's wall-clock

@@ -1,0 +1,117 @@
+"""Convert an ISPRS Vaihingen/Potsdam checkout into the tile-dir format.
+
+The ISPRS 2D semantic labeling benchmarks ship large orthophoto scenes
+(`top_mosaic_*.tif` / `top_potsdam_*_RGB.tif`) with RGB **color-coded**
+ground truth: each class is a pure color, not an index.  The reference
+consumed a privately pre-converted folder of images + ``.npy`` index masks
+(кластер.py:660-674) and never shipped the converter; this is that missing
+tool.  Output pairs (`<stem>.png`/`.npy`) feed ``load_scene_dir`` (crop
+mode — the intended path for these large scenes) or ``load_tile_dir``.
+
+    python scripts/prepare_isprs.py --images /data/vaihingen/top \
+        --labels /data/vaihingen/gts --out /data/vaihingen_scenes
+
+Standard ISPRS class colors (both datasets):
+  0 impervious surface (255,255,255)   3 tree       (0,255,0)
+  1 building           (0,0,255)       4 car        (255,255,0)
+  2 low vegetation     (0,255,255)     5 clutter    (255,0,0)
+Pixels whose color matches no class (e.g. boundary-eroded variants) map to
+void (-1), which loss/metrics ignore.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+ISPRS_COLORS = np.array(
+    [
+        [255, 255, 255],  # impervious surface
+        [0, 0, 255],  # building
+        [0, 255, 255],  # low vegetation
+        [0, 255, 0],  # tree
+        [255, 255, 0],  # car
+        [255, 0, 0],  # clutter
+    ],
+    np.uint8,
+)
+VOID = -1
+
+
+def colors_to_indices(rgb: np.ndarray) -> np.ndarray:
+    """[H, W, 3] uint8 color-coded mask → [H, W] int32 class ids, void=-1.
+
+    Implemented as one 24-bit LUT lookup (no per-class masking loops):
+    O(HW) with a single gather, fine for 10⁸-pixel Potsdam scenes.
+    """
+    lut = np.full(1 << 24, VOID, np.int32)
+    keys = (
+        (ISPRS_COLORS[:, 0].astype(np.int64) << 16)
+        | (ISPRS_COLORS[:, 1].astype(np.int64) << 8)
+        | ISPRS_COLORS[:, 2].astype(np.int64)
+    )
+    lut[keys] = np.arange(len(ISPRS_COLORS), dtype=np.int32)
+    rgb = rgb[..., :3].astype(np.int64)
+    packed = (rgb[..., 0] << 16) | (rgb[..., 1] << 8) | rgb[..., 2]
+    return lut[packed]
+
+
+def _stem(name: str) -> str:
+    base = name[: name.rindex(".")] if "." in name else name
+    for suffix in ("_label", "_labels", "_gt", "_RGB"):
+        base = base.removesuffix(suffix)
+    return base
+
+
+def convert(images_dir: str, labels_dir: str, out_dir: str, limit: int = 0) -> int:
+    import imageio.v2 as imageio
+    from PIL import Image
+
+    Image.MAX_IMAGE_PIXELS = None  # ISPRS scenes exceed PIL's default cap
+    label_by_stem = {}
+    for name in sorted(os.listdir(labels_dir)):
+        path = os.path.join(labels_dir, name)
+        if os.path.isfile(path):
+            label_by_stem[_stem(name)] = path
+    os.makedirs(out_dir, exist_ok=True)
+    n = 0
+    for name in sorted(os.listdir(images_dir)):
+        path = os.path.join(images_dir, name)
+        if not os.path.isfile(path):
+            continue
+        stem = _stem(name)
+        if stem not in label_by_stem:
+            raise FileNotFoundError(
+                f"no label for image {name} (stem {stem!r}) in {labels_dir}"
+            )
+        img = np.asarray(imageio.imread(path))[..., :3]
+        mask = colors_to_indices(np.asarray(imageio.imread(label_by_stem[stem])))
+        if img.shape[:2] != mask.shape:
+            raise ValueError(
+                f"{stem}: image {img.shape[:2]} != label {mask.shape}"
+            )
+        imageio.imwrite(os.path.join(out_dir, f"{stem}.png"), img)
+        np.save(os.path.join(out_dir, f"{stem}.npy"), mask)
+        n += 1
+        if limit and n >= limit:
+            break
+    if n == 0:
+        raise FileNotFoundError(f"no images found in {images_dir}")
+    return n
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--images", required=True, help="dir of orthophoto scenes")
+    p.add_argument("--labels", required=True, help="dir of color-coded GT")
+    p.add_argument("--out", required=True)
+    p.add_argument("--limit", type=int, default=0)
+    args = p.parse_args()
+    n = convert(args.images, args.labels, args.out, args.limit)
+    print(f"wrote {n} (image, index-mask) scene pairs to {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""ISPRS color-coded label conversion (the converter the reference's
+privately-prepared .npy folder implies but never ships, кластер.py:660-674)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+from prepare_isprs import ISPRS_COLORS, colors_to_indices, convert  # noqa: E402
+
+
+def test_color_mapping_roundtrip():
+    rgb = ISPRS_COLORS[np.array([[0, 1, 2], [3, 4, 5]])]
+    np.testing.assert_array_equal(
+        colors_to_indices(rgb), [[0, 1, 2], [3, 4, 5]]
+    )
+    # Unknown colors (e.g. eroded boundaries) → void.
+    odd = np.full((2, 2, 3), 17, np.uint8)
+    assert (colors_to_indices(odd) == -1).all()
+
+
+def test_convert_and_crop_train(tmp_path):
+    import imageio.v2 as imageio
+
+    from ddlpc_tpu.data import CropDataset, load_scene_dir
+
+    img_dir, lab_dir, out = (
+        tmp_path / "top",
+        tmp_path / "gts",
+        tmp_path / "scenes",
+    )
+    img_dir.mkdir()
+    lab_dir.mkdir()
+    rng = np.random.default_rng(0)
+    for i in range(2):
+        h, w = 40 + 8 * i, 56
+        imageio.imwrite(
+            img_dir / f"top_mosaic_{i}.png",
+            rng.integers(0, 255, (h, w, 3), dtype=np.uint8),
+        )
+        classes = rng.integers(0, 6, (h, w))
+        imageio.imwrite(
+            lab_dir / f"top_mosaic_{i}_label.png", ISPRS_COLORS[classes]
+        )
+    n = convert(str(img_dir), str(lab_dir), str(out))
+    assert n == 2
+    scenes = load_scene_dir(str(out))
+    assert len(scenes) == 2
+    assert set(np.unique(scenes[0][1])) <= set(range(6))
+    # The converted scenes feed the random-crop training path directly.
+    ds = CropDataset(scenes, crop_size=(16, 16), crops_per_epoch=8)
+    imgs, labs = ds.gather(np.arange(8))
+    assert imgs.shape == (8, 16, 16, 3) and labs.shape == (8, 16, 16)
+
+
+def test_convert_missing_label_raises(tmp_path):
+    import imageio.v2 as imageio
+    import pytest
+
+    (tmp_path / "top").mkdir()
+    (tmp_path / "gts").mkdir()
+    imageio.imwrite(
+        tmp_path / "top" / "a.png", np.zeros((8, 8, 3), np.uint8)
+    )
+    with pytest.raises(FileNotFoundError, match="no label"):
+        convert(str(tmp_path / "top"), str(tmp_path / "gts"), str(tmp_path / "o"))
