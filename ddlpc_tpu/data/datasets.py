@@ -328,15 +328,30 @@ def load_scene_dir(
     return scenes
 
 
+LABEL_SUFFIXES = ("_mask", "_label", "_labels", "_gt", "_noBoundary", "_RGB")
+
+
+def file_stem(name: str, suffixes: Tuple[str, ...] = LABEL_SUFFIXES) -> str:
+    """Filename → pairing stem: drop the extension, then strip label/image
+    suffixes repeatedly (handles nested forms like ``_label_noBoundary``).
+    One shared implementation so converters (scripts/prepare_isprs.py) and
+    loaders can never disagree about which files pair."""
+    base = os.path.basename(name)
+    base = base[: base.rindex(".")] if "." in base else base
+    stripped = True
+    while stripped:
+        stripped = False
+        for suffix in suffixes:
+            if base.endswith(suffix):
+                base = base.removesuffix(suffix)
+                stripped = True
+    return base
+
+
 def _paired_files(path: str) -> Tuple[dict, dict]:
     """{stem: image_path}, {stem: npy_path} with strict 1:1 stem matching."""
 
-    def stem(f: str) -> str:
-        base = os.path.basename(f)
-        base = base[: base.rindex(".")] if "." in base else base
-        for suffix in ("_mask", "_label", "_labels", "_gt"):
-            base = base.removesuffix(suffix)
-        return base
+    stem = file_stem
 
     img_by_stem: dict = {}
     npy_by_stem: dict = {}

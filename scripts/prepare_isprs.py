@@ -58,11 +58,11 @@ def colors_to_indices(rgb: np.ndarray) -> np.ndarray:
     return lut[packed]
 
 
-def _stem(name: str) -> str:
-    base = name[: name.rindex(".")] if "." in name else name
-    for suffix in ("_label", "_labels", "_gt", "_RGB"):
-        base = base.removesuffix(suffix)
-    return base
+# Shared with the loaders' pairing rules (handles _label/_gt/_noBoundary
+# and nested forms) so converter output and loader input can never disagree.
+from ddlpc_tpu.data.datasets import file_stem as _stem  # noqa: E402
+
+_IMAGE_EXTS = (".tif", ".tiff", ".png", ".jpg", ".jpeg", ".bmp")
 
 
 def convert(images_dir: str, labels_dir: str, out_dir: str, limit: int = 0) -> int:
@@ -70,16 +70,22 @@ def convert(images_dir: str, labels_dir: str, out_dir: str, limit: int = 0) -> i
     from PIL import Image
 
     Image.MAX_IMAGE_PIXELS = None  # ISPRS scenes exceed PIL's default cap
+
+    def is_image(name: str) -> bool:
+        # The official downloads ship sidecars next to the rasters (e.g.
+        # Potsdam .tfw world files) — filter by extension, not isfile.
+        return name.lower().endswith(_IMAGE_EXTS)
+
     label_by_stem = {}
     for name in sorted(os.listdir(labels_dir)):
         path = os.path.join(labels_dir, name)
-        if os.path.isfile(path):
+        if os.path.isfile(path) and is_image(name):
             label_by_stem[_stem(name)] = path
     os.makedirs(out_dir, exist_ok=True)
     n = 0
     for name in sorted(os.listdir(images_dir)):
         path = os.path.join(images_dir, name)
-        if not os.path.isfile(path):
+        if not os.path.isfile(path) or not is_image(name):
             continue
         stem = _stem(name)
         if stem not in label_by_stem:

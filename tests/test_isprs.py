@@ -55,6 +55,28 @@ def test_convert_and_crop_train(tmp_path):
     assert imgs.shape == (8, 16, 16, 3) and labs.shape == (8, 16, 16)
 
 
+def test_convert_skips_sidecars_and_pairs_noboundary(tmp_path):
+    """Potsdam-style layout: .tfw sidecars next to rasters, eroded GT with
+    the _label_noBoundary nested suffix — both must work."""
+    import imageio.v2 as imageio
+
+    img_dir, lab_dir = tmp_path / "top", tmp_path / "gts"
+    img_dir.mkdir()
+    lab_dir.mkdir()
+    rng = np.random.default_rng(0)
+    imageio.imwrite(
+        img_dir / "top_potsdam_2_10_RGB.png",
+        rng.integers(0, 255, (24, 24, 3), dtype=np.uint8),
+    )
+    (img_dir / "top_potsdam_2_10_RGB.tfw").write_text("1\n0\n0\n-1\n0\n0\n")
+    imageio.imwrite(
+        lab_dir / "top_potsdam_2_10_label_noBoundary.png",
+        ISPRS_COLORS[rng.integers(0, 6, (24, 24))],
+    )
+    n = convert(str(img_dir), str(lab_dir), str(tmp_path / "o"))
+    assert n == 1
+
+
 def test_convert_missing_label_raises(tmp_path):
     import imageio.v2 as imageio
     import pytest
