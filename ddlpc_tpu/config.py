@@ -99,6 +99,11 @@ class TrainConfig:
     learning_rate: float = 1e-3  # torch.optim.Adam default, as the reference uses (кластер.py:704)
     optimizer: str = "adam"
     weight_decay: float = 0.0
+    # 'constant' (reference behavior: fixed default-LR Adam, кластер.py:704)
+    # or 'cosine' (linear warmup over warmup_steps, cosine decay to 0 over
+    # the run's total optimizer steps — the Trainer supplies the horizon).
+    lr_schedule: str = "constant"
+    warmup_steps: int = 0
     seed: int = 0
     log_every_steps: int = 1
     checkpoint_every_epochs: int = 1

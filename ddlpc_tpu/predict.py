@@ -117,7 +117,9 @@ def load_run(workdir: str):
     channels = int(meta.get("input_channels", 3))
     # Inference is single-device: no mesh axis for BN stats.
     model = build_model(cfg.model, norm_axis_name=None)
-    tx = build_optimizer(cfg.train)
+    # Dummy schedule horizon: only the optimizer state STRUCTURE matters
+    # for restore, and decaying schedules would refuse total_steps=None.
+    tx = build_optimizer(cfg.train, total_steps=1)
     h, w = cfg.data.image_size
     state = create_train_state(
         model, tx, jax.random.key(0), (1, h, w, channels)

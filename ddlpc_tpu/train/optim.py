@@ -8,18 +8,49 @@ TrainState pytree.
 
 from __future__ import annotations
 
+from typing import Optional, Union
+
 import optax
 
 from ddlpc_tpu.config import TrainConfig
 
 
-def build_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+def build_schedule(
+    cfg: TrainConfig, total_steps: Optional[int] = None
+) -> Union[float, optax.Schedule]:
+    """LR schedule from config.  ``total_steps`` is the run's optimizer-step
+    horizon (epochs × steps/epoch), required for decaying schedules."""
+    if cfg.lr_schedule == "constant":
+        if cfg.warmup_steps:
+            return optax.linear_schedule(
+                0.0, cfg.learning_rate, cfg.warmup_steps
+            )
+        return cfg.learning_rate
+    if cfg.lr_schedule == "cosine":
+        if not total_steps or total_steps <= 0:
+            raise ValueError(
+                "lr_schedule='cosine' needs the run's total step count; "
+                "construct through the Trainer or pass total_steps"
+            )
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=cfg.learning_rate,
+            warmup_steps=min(cfg.warmup_steps, max(total_steps - 1, 0)),
+            decay_steps=total_steps,
+        )
+    raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r}")
+
+
+def build_optimizer(
+    cfg: TrainConfig, total_steps: Optional[int] = None
+) -> optax.GradientTransformation:
+    lr = build_schedule(cfg, total_steps)
     if cfg.optimizer == "adam":
-        tx = optax.adam(cfg.learning_rate)
+        tx = optax.adam(lr)
     elif cfg.optimizer == "adamw":
-        tx = optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay)
+        tx = optax.adamw(lr, weight_decay=cfg.weight_decay)
     elif cfg.optimizer == "sgd":
-        tx = optax.sgd(cfg.learning_rate, momentum=0.9)
+        tx = optax.sgd(lr, momentum=0.9)
     else:
         raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
     if cfg.weight_decay and cfg.optimizer == "adam":

@@ -79,56 +79,8 @@ class Trainer:
 
         self.train_ds, self.test_ds = build_dataset(cfg.data)
         self.model = build_model_from_experiment(cfg)
-        self.tx = build_optimizer(cfg.train)
-
-        h, w = cfg.data.image_size
-        channels = self.train_ds.image_shape[-1]
-        self.state = create_train_state(
-            self.model,
-            self.tx,
-            jax.random.key(cfg.train.seed),
-            (1, h, w, channels),
-        )
-        self.state = jax.device_put(self.state, NamedSharding(self.mesh, P()))
-
-        # Pure data mesh → hand-written shard_map collectives (reference-
-        # parity codec semantics); data×space mesh → GSPMD, where XLA
-        # partitions convs along H with automatic halo exchange.
         self.spatial = cfg.parallel.space_axis_size > 1
         space = cfg.parallel.space_axis_name if self.spatial else None
-        if self.spatial:
-            self.train_step = make_train_step_gspmd(
-                self.model,
-                self.tx,
-                self.mesh,
-                cfg.compression,
-                data_axis=cfg.parallel.data_axis_name,
-                space_axis=space,
-                remat=cfg.train.remat,
-            )
-            self.eval_step = make_eval_step_gspmd(
-                self.model,
-                self.mesh,
-                num_classes=cfg.model.num_classes,
-                data_axis=cfg.parallel.data_axis_name,
-                space_axis=space,
-            )
-        else:
-            self.train_step = make_train_step(
-                self.model,
-                self.tx,
-                self.mesh,
-                cfg.compression,
-                data_axis=cfg.parallel.data_axis_name,
-                remat=cfg.train.remat,
-            )
-            self.eval_step = make_eval_step(
-                self.model,
-                self.mesh,
-                num_classes=cfg.model.num_classes,
-                data_axis=cfg.parallel.data_axis_name,
-            )
-        self.predict = make_predict_fn(self.model)
 
         loader_cls = (
             DeviceCachedLoader if cfg.data.device_cache else ShardedLoader
@@ -143,6 +95,42 @@ class Trainer:
             data_axis=cfg.parallel.data_axis_name,
             space_axis=space,
         )
+        # Step horizon for decaying LR schedules comes from the loader (one
+        # source of truth for steps/epoch, including tail semantics).
+        self.tx = build_optimizer(
+            cfg.train, total_steps=cfg.train.epochs * len(self.loader)
+        )
+
+        h, w = cfg.data.image_size
+        channels = self.train_ds.image_shape[-1]
+        self.state = create_train_state(
+            self.model,
+            self.tx,
+            jax.random.key(cfg.train.seed),
+            (1, h, w, channels),
+        )
+        self.state = jax.device_put(self.state, NamedSharding(self.mesh, P()))
+
+        # Pure data mesh → hand-written shard_map collectives (reference-
+        # parity codec semantics); data×space mesh → GSPMD, where XLA
+        # partitions convs along H with automatic halo exchange.
+        self.train_step = self._build_train_step()
+        if self.spatial:
+            self.eval_step = make_eval_step_gspmd(
+                self.model,
+                self.mesh,
+                num_classes=cfg.model.num_classes,
+                data_axis=cfg.parallel.data_axis_name,
+                space_axis=space,
+            )
+        else:
+            self.eval_step = make_eval_step(
+                self.model,
+                self.mesh,
+                num_classes=cfg.model.num_classes,
+                data_axis=cfg.parallel.data_axis_name,
+            )
+        self.predict = make_predict_fn(self.model)
 
         self.workdir = cfg.workdir
         self.ckpt_dir = os.path.join(self.workdir, "checkpoints")
@@ -151,6 +139,27 @@ class Trainer:
             self._restore_synchronized()
         self.logger = MetricsLogger(self.workdir, run_config_json=cfg.to_json())
         self.timer = StageTimer()
+
+    def _build_train_step(self):
+        cfg = self.cfg
+        if self.spatial:
+            return make_train_step_gspmd(
+                self.model,
+                self.tx,
+                self.mesh,
+                cfg.compression,
+                data_axis=cfg.parallel.data_axis_name,
+                space_axis=cfg.parallel.space_axis_name,
+                remat=cfg.train.remat,
+            )
+        return make_train_step(
+            self.model,
+            self.tx,
+            self.mesh,
+            cfg.compression,
+            data_axis=cfg.parallel.data_axis_name,
+            remat=cfg.train.remat,
+        )
 
     def _restore_synchronized(self) -> None:
         """Resume with process 0 as the single source of truth.
@@ -296,6 +305,15 @@ class Trainer:
         """Run the full training; returns the last epoch's metrics record."""
         cfg = self.cfg.train
         epochs = epochs if epochs is not None else cfg.epochs
+        if epochs != cfg.epochs and cfg.lr_schedule != "constant":
+            # The decaying schedule's horizon was built from cfg.epochs; an
+            # overridden epoch budget would otherwise clamp at LR 0 past the
+            # configured horizon (or end early).  Rebuild over the actual
+            # horizon — the optimizer state structure is unchanged.
+            self.tx = build_optimizer(
+                cfg, total_steps=epochs * len(self.loader)
+            )
+            self.train_step = self._build_train_step()
         record: Dict[str, float] = {}
         for epoch in range(self.start_epoch, epochs):
             with maybe_profile(
