@@ -40,8 +40,32 @@ class Encoded(NamedTuple):
     tree: PyTree  # int8 or fp16 leaves, same structure as the input
 
 
-def _levels(cfg: CompressionConfig) -> int:
-    return cfg.int8_levels if cfg.mode == "int8" else cfg.fp16_levels
+def levels_for(cfg: CompressionConfig) -> int:
+    """Level count for a quantizing mode; raises on unknown modes so every
+    codec consumer (simulate and ring transport alike) rejects them."""
+    if cfg.mode == "int8":
+        return cfg.int8_levels
+    if cfg.mode == "float16":
+        return cfg.fp16_levels
+    raise ValueError(f"unknown compression mode {cfg.mode!r}")
+
+
+def quantize_with_scale(x: jax.Array, safe_scale: jax.Array, levels: float) -> jax.Array:
+    """round(x / scale · levels) clipped to ±levels, as fp32 lattice values.
+
+    The one quantization formula, shared by the simulate codec (encode) and
+    the ring transport (compressed_allreduce.py) so their loss points cannot
+    drift.  ``safe_scale`` must already be zero-guarded (see encode)."""
+    return jnp.clip(
+        jnp.round(x.astype(jnp.float32) / safe_scale * levels), -levels, levels
+    )
+
+
+def safe_divisor(scale: jax.Array) -> jax.Array:
+    """Zero-guard for the reference's max==0 crash (кластер.py:345-396): a
+    zero scale makes g/scale NaN; divide by 1 instead (the quantized values
+    are all 0 anyway when scale == 0)."""
+    return jnp.where(scale > 0, scale, 1.0)
 
 
 def global_absmax(tree: PyTree) -> jax.Array:
@@ -58,28 +82,14 @@ def global_absmax(tree: PyTree) -> jax.Array:
 def encode(tree: PyTree, cfg: CompressionConfig) -> Encoded:
     """Quantize a gradient pytree.  mode='none' stores fp32 unchanged."""
     scale = global_absmax(tree)
-    # Guard the reference's max==0 crash: a zero scale makes g/scale NaN; use
-    # a safe divisor (the encoded values are all 0 anyway when scale == 0).
-    safe = jnp.where(scale > 0, scale, 1.0)
+    safe = safe_divisor(scale)
     if cfg.mode == "none":
         return Encoded(scale, jax.tree.map(lambda g: g.astype(jnp.float32), tree))
-    levels = float(_levels(cfg))
-    if cfg.mode == "int8":
-        q = jax.tree.map(
-            lambda g: jnp.clip(
-                jnp.round(g.astype(jnp.float32) / safe * levels), -127, 127
-            ).astype(jnp.int8),
-            tree,
-        )
-    elif cfg.mode == "float16":
-        q = jax.tree.map(
-            lambda g: jnp.round(g.astype(jnp.float32) / safe * levels).astype(
-                jnp.float16
-            ),
-            tree,
-        )
-    else:
-        raise ValueError(f"unknown compression mode {cfg.mode!r}")
+    levels = float(levels_for(cfg))
+    out_dtype = jnp.int8 if cfg.mode == "int8" else jnp.float16
+    q = jax.tree.map(
+        lambda g: quantize_with_scale(g, safe, levels).astype(out_dtype), tree
+    )
     return Encoded(scale, q)
 
 
@@ -87,7 +97,7 @@ def decode(enc: Encoded, cfg: CompressionConfig) -> PyTree:
     """Dequantize: q / levels * scale (кластер.py:533,543)."""
     if cfg.mode == "none":
         return enc.tree
-    levels = float(_levels(cfg))
+    levels = float(levels_for(cfg))
     return jax.tree.map(
         lambda q: q.astype(jnp.float32) / levels * enc.scale, enc.tree
     )
@@ -106,4 +116,4 @@ def quantization_error_bound(cfg: CompressionConfig) -> float:
     absmax: half a quantization step."""
     if cfg.mode == "none":
         return 0.0
-    return 0.5 / _levels(cfg)
+    return 0.5 / levels_for(cfg)

@@ -1,0 +1,170 @@
+"""Wire-compressed ring all-reduce: quantized bytes on the interconnect.
+
+The reference's research contribution is sending FEWER BYTES for gradient
+synchronization over a slow link: workers int8/fp16-quantize gradients before
+the TCP send and the server re-quantizes the averaged gradient for the
+broadcast (кластер.py:450-503, 328-396).  The framework's default codec path
+(`grad_sync.sync_gradients`) reproduces that scheme's *information loss*
+inside a plain `lax.pmean` — semantically exact, but the all-reduce itself
+still moves fp32 over ICI/DCN, because XLA's collectives have no quantized
+wire format.
+
+This module moves the actual wire bytes: a hand-written ring
+reduce-scatter + ring all-gather built from `lax.ppermute`, where every hop
+transfers the smallest integer dtype that can hold the running partial sum —
+int8 when ``axis_size * levels <= 127`` (the reference's ±10-level int8 codec
+on an 8-way mesh sends exactly 1 byte/element/hop, 4× less than fp32),
+int16 otherwise.  On DCN-bound multi-host meshes, where link bandwidth is
+the constraint the reference designed for, this is the TPU-native
+realization of its compressed transport; within one ICI slice the native
+fp32 `psum` is usually faster and remains the default.
+
+Quantization semantics (mirroring the reference's two loss points):
+- one *shared* scale = `pmax` of the per-replica global absmax (the
+  reference uses each worker's own absmax, кластер.py:463-471; a shared
+  scale is required for integer summation on the wire and is never smaller,
+  so per-element error bounds are unchanged);
+- each replica quantizes once before the reduce (client wire,
+  кластер.py:474-496) — the integer partial sums then accumulate EXACTLY,
+  unlike float wire formats;
+- the averaged chunk is re-quantized once for the all-gather hops (server
+  rebroadcast, кластер.py:328-396), so every replica decodes bit-identical
+  mean gradients — the reference's self-application guarantee
+  (кластер.py:402-433) by construction.
+
+Total per-element error ≤ scale/levels (one half-step per quantization,
+two quantizations) — the same bound as the simulate path with
+``quantize_local=quantize_mean=True``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ddlpc_tpu.config import CompressionConfig
+
+PyTree = Any
+
+
+def _ring_perm(axis_size: int) -> List[Tuple[int, int]]:
+    """Unidirectional ring: rank i sends to rank (i+1) % N."""
+    return [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+
+def wire_dtype(axis_size: int, levels: int) -> jnp.dtype:
+    """Smallest integer dtype holding any ring partial sum (≤ N·levels).
+
+    Raises when only int32 would fit: 4-byte hops are the same wire bytes as
+    the native fp32 psum, so the ring would add 2(N-1) hops of latency for
+    zero compression — use transport='simulate' (or fewer levels) there."""
+    peak = axis_size * levels
+    if peak <= 127:
+        return jnp.int8
+    if peak <= 32767:
+        return jnp.int16
+    raise ValueError(
+        f"ring transport with {levels} levels on {axis_size} replicas needs "
+        f"int32 hops (peak partial sum {peak}) — that moves the same bytes "
+        "as the native fp32 all-reduce; use transport='simulate' instead"
+    )
+
+
+def _flatten(tree: PyTree) -> Tuple[jax.Array, List[Any], Any]:
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = [l.shape for l in leaves]
+    flat = jnp.concatenate([l.astype(jnp.float32).ravel() for l in leaves])
+    return flat, shapes, treedef
+
+def _unflatten(flat: jax.Array, shapes: Sequence[Any], treedef: Any) -> PyTree:
+    out, offset = [], 0
+    for shape in shapes:
+        size = int(np.prod(shape)) if shape else 1
+        out.append(lax.dynamic_slice_in_dim(flat, offset, size).reshape(shape))
+        offset += size
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def ring_allreduce_mean_quantized(
+    tree: PyTree,
+    axis_name: str,
+    axis_size: int,
+    cfg: CompressionConfig,
+) -> PyTree:
+    """Mean ``tree`` across ``axis_name`` with quantized bytes on every hop.
+
+    Must be called inside `shard_map`/`pmap` over an axis of (static) size
+    ``axis_size``.  ``cfg.mode`` selects the level count exactly as the
+    simulate-path codec does ('int8' → ±int8_levels, 'float16' →
+    ±fp16_levels); 'none' falls back to an exact `lax.pmean`.
+    """
+    if cfg.mode == "none":
+        return lax.pmean(tree, axis_name)
+    if not jax.tree_util.tree_leaves(tree):
+        return tree
+    if axis_size == 1:
+        # Single replica: the mean is the identity; apply the codec's two
+        # quantization points so semantics match the N>1 path.
+        from ddlpc_tpu.ops.quantize import fake_quantize
+
+        return fake_quantize(fake_quantize(tree, cfg), cfg)
+
+    from ddlpc_tpu.ops.quantize import levels_for, quantize_with_scale, safe_divisor
+
+    levels = float(levels_for(cfg))
+    flat, shapes, treedef = _flatten(tree)
+    n = flat.shape[0]
+
+    # Shared scale: max over replicas of the whole-model absmax.  One scalar
+    # collective — negligible next to the gradient payload.
+    scale = lax.pmax(jnp.max(jnp.abs(flat)), axis_name)
+    safe = safe_divisor(scale)
+
+    # Quantize ONCE per replica (client-wire loss point, кластер.py:474-496).
+    q = quantize_with_scale(flat, safe, levels)
+
+    # Pad so the vector splits into axis_size equal chunks.
+    chunk = -(-n // axis_size)  # ceil
+    q = jnp.pad(q, (0, chunk * axis_size - n)).reshape(axis_size, chunk)
+
+    wdt = wire_dtype(axis_size, int(levels))
+    perm = _ring_perm(axis_size)
+    rank = lax.axis_index(axis_name)
+
+    # --- ring reduce-scatter (N-1 hops, integer partial sums: EXACT) -------
+    # Invariant: after k hops the travelling partial at rank r covers chunk
+    # (r + 1 - k) mod N summed over ranks r-k..r.  After N-1 hops rank r owns
+    # the full sum of chunk (r + 2) mod N.
+    own0 = (rank + 1) % axis_size
+    partial = lax.dynamic_index_in_dim(q, own0, keepdims=False)
+    for k in range(1, axis_size):
+        partial = lax.ppermute(partial.astype(wdt), axis_name, perm)
+        idx = (rank + 1 - k) % axis_size
+        partial = partial.astype(jnp.float32) + lax.dynamic_index_in_dim(
+            q, idx, keepdims=False
+        )
+    own = (rank + 2) % axis_size
+
+    # Mean, then re-quantize ONCE for the broadcast hops (server-rebroadcast
+    # loss point, кластер.py:328-396).  |mean| ≤ scale, so the same scale is
+    # valid and the gather hops carry signed values ≤ levels: int8 always
+    # suffices here, but we keep ``wdt`` for a single wire format.
+    mean_q = jnp.clip(
+        jnp.round(partial / axis_size), -levels, levels
+    ).astype(wdt)
+
+    # --- ring all-gather of the quantized mean chunks (N-1 hops) -----------
+    out = jnp.zeros((axis_size, chunk), wdt)
+    out = lax.dynamic_update_index_in_dim(out, mean_q, own, axis=0)
+    travelling = mean_q
+    for k in range(1, axis_size):
+        travelling = lax.ppermute(travelling, axis_name, perm)
+        idx = (rank - k + 2) % axis_size  # chunk owned by rank r-k
+        out = lax.dynamic_update_index_in_dim(out, travelling, idx, axis=0)
+
+    mean_flat = out.reshape(-1)[:n].astype(jnp.float32) / levels * scale
+    return _unflatten(mean_flat, shapes, treedef)

@@ -23,7 +23,7 @@ round-trips.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import jax
 from jax import lax
@@ -35,7 +35,10 @@ PyTree = Any
 
 
 def sync_gradients(
-    grads: PyTree, axis_name: str, compression: CompressionConfig
+    grads: PyTree,
+    axis_name: str,
+    compression: CompressionConfig,
+    axis_size: Optional[int] = None,
 ) -> PyTree:
     """All-reduce-mean local gradients across ``axis_name``.
 
@@ -43,7 +46,36 @@ def sync_gradients(
     pmean; otherwise the codec's information loss is injected at the same
     points the reference loses it (client send: quantize_local; server
     rebroadcast: quantize_mean).
+
+    ``compression.transport='ring'`` swaps the fp32 pmean for the
+    byte-compressed ppermute ring (compressed_allreduce.py), which needs the
+    static ``axis_size`` of the mesh axis.
     """
+    if compression.transport not in ("simulate", "ring"):
+        raise ValueError(
+            f"unknown compression transport {compression.transport!r} "
+            "(expected 'simulate' or 'ring')"
+        )
+    if compression.transport == "ring" and compression.mode != "none":
+        if axis_size is None:
+            raise ValueError(
+                "transport='ring' needs the static axis_size (the step "
+                "builders pass mesh.shape[data_axis])"
+            )
+        if not (compression.quantize_local and compression.quantize_mean):
+            raise ValueError(
+                "transport='ring' quantizes at both loss points by "
+                "construction (integer wire sums + quantized gather hops); "
+                "quantize_local/quantize_mean=False ablations need "
+                "transport='simulate'"
+            )
+        from ddlpc_tpu.parallel.compressed_allreduce import (
+            ring_allreduce_mean_quantized,
+        )
+
+        return ring_allreduce_mean_quantized(
+            grads, axis_name, axis_size, compression
+        )
     if compression.quantize_local:
         grads = fake_quantize(grads, compression)
     grads = lax.pmean(grads, axis_name)

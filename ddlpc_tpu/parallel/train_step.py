@@ -183,7 +183,9 @@ def make_train_step(
             lambda x: lax.pmean(x, data_axis), batch_stats
         )
         # The one collective of the step — replaces reference L0–L4.
-        grads = sync_gradients(grads, data_axis, compression)
+        grads = sync_gradients(
+            grads, data_axis, compression, axis_size=mesh.shape[data_axis]
+        )
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         metrics = {
@@ -247,6 +249,12 @@ def make_train_step_gspmd(
             "(there is no per-replica gradient in the program): set "
             "compression.quantize_mean=True, or mode='none', or use a pure "
             "data mesh for reference-parity codec semantics"
+        )
+    if compression.transport == "ring" and compression.mode != "none":
+        raise ValueError(
+            "transport='ring' requires explicit per-replica collectives — "
+            "use the shard_map step (pure data mesh); the GSPMD partitioner "
+            "owns the collectives in this path"
         )
 
     def step_fn(state: TrainState, images: jax.Array, labels: jax.Array):
