@@ -72,7 +72,16 @@ TIMED_ROUNDS = 3
 # кластер.py:620-656) for apples-to-apples comparison.
 BENCHES = {
     "unet_vaihingen512": dict(
-        model=dict(width_divisor=2, num_classes=6, stem="s2d", stem_factor=4),
+        # head_dtype=bfloat16 halves the logit-head HBM traffic (the largest
+        # activation with the subpixel head); convergence guarded by
+        # tests/test_models.py::test_bf16_head_learns.
+        model=dict(
+            width_divisor=2,
+            num_classes=6,
+            stem="s2d",
+            stem_factor=4,
+            head_dtype="bfloat16",
+        ),
         image=(512, 512),
         # B=64/chip fits v5e HBM with the factor-4 stem (B=96 also fits and
         # is ~19% faster still; 64 keeps headroom) — see docs/PERF.md sweep.
@@ -88,11 +97,14 @@ BENCHES = {
         compression="float16",
     ),
     "unetpp_vaihingen512": dict(
+        # bf16 heads are worth 1.76× here: four deep-supervision heads emit
+        # full-resolution logits each step.
         model=dict(
             name="unetpp",
             num_classes=6,
             features=(32, 64, 128, 256, 512),
             deep_supervision=True,
+            head_dtype="bfloat16",
         ),
         image=(512, 512),
         micro_batch=8,
@@ -105,6 +117,7 @@ BENCHES = {
             num_classes=6,
             features=(64, 128, 256, 512),
             output_stride=16,
+            head_dtype="bfloat16",
         ),
         image=(512, 512),
         micro_batch=32,
@@ -112,7 +125,13 @@ BENCHES = {
         compression="none",
     ),
     "unet_cityscapes512x1024": dict(
-        model=dict(width_divisor=1, num_classes=19, stem="s2d", stem_factor=4),
+        model=dict(
+            width_divisor=1,
+            num_classes=19,
+            stem="s2d",
+            stem_factor=4,
+            head_dtype="bfloat16",
+        ),
         image=(512, 1024),
         micro_batch=12,
         sync_period=4,

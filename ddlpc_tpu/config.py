@@ -44,6 +44,12 @@ class ModelConfig:
     output_stride: int = 16
     aspp_rates: Tuple[int, ...] = (6, 12, 18)
     compute_dtype: str = "bfloat16"  # dtype activations are computed in
+    # Dtype of the logit head and the logits the model returns.  'float32'
+    # is the conservative default; 'bfloat16' halves the HBM traffic of the
+    # largest activation in the net ([B,H,W,C·r²] for subpixel heads and the
+    # full-resolution logit upsample) — the loss/metrics cast to fp32 before
+    # any softmax/reduction either way, so only logit *storage* rounds.
+    head_dtype: str = "float32"  # float32 | bfloat16
 
 
 @dataclass(frozen=True)

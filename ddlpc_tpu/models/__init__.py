@@ -43,6 +43,7 @@ def _build_unet(cfg: ModelConfig, norm_axis_name: Optional[str]) -> nn.Module:
         stem=cfg.stem,
         stem_factor=cfg.stem_factor,
         dtype=jnp.dtype(cfg.compute_dtype),
+        head_dtype=jnp.dtype(cfg.head_dtype),
     )
 
 
@@ -60,6 +61,7 @@ def _build_unetpp(cfg: ModelConfig, norm_axis_name: Optional[str]) -> nn.Module:
         norm_groups=cfg.group_norm_groups,
         deep_supervision=cfg.deep_supervision,
         dtype=jnp.dtype(cfg.compute_dtype),
+        head_dtype=jnp.dtype(cfg.head_dtype),
     )
 
 
@@ -77,6 +79,7 @@ def _build_deeplab(cfg: ModelConfig, norm_axis_name: Optional[str]) -> nn.Module
         norm_axis_name=norm_axis_name,
         norm_groups=cfg.group_norm_groups,
         dtype=jnp.dtype(cfg.compute_dtype),
+        head_dtype=jnp.dtype(cfg.head_dtype),
     )
 
 

@@ -134,6 +134,7 @@ class DeepLabV3Plus(nn.Module):
     norm_axis_name: Optional[str] = None
     norm_groups: int = 8
     dtype: Any = jnp.bfloat16
+    head_dtype: Any = jnp.float32  # see ModelConfig.head_dtype
 
     def _w(self, f: int) -> int:
         return max(1, f // self.width_divisor)
@@ -152,9 +153,15 @@ class DeepLabV3Plus(nn.Module):
             norm_groups=self.norm_groups,
             dtype=self.dtype,
         )
-        # Stem: stride-2 conv + pool → stride 4.
-        y = ConvNormAct(self._w(self.stem_features), **common)(x, train)
-        y = nn.max_pool(y, (3, 3), strides=(2, 2), padding="SAME")
+        # Stem: stride-2 conv + stride-2 pool → stride 4, the canonical
+        # ResNet entry (He et al. 2016).  The conv itself is strided so no
+        # C=64 activation ever exists at full input resolution — a stride-1
+        # stem at 512² cost ~36% of the whole train step on v5e (the conv,
+        # its BatchNorm reductions, and a select-and-scatter pool-backward
+        # over [B,512,512,64] dominated the trace; docs/PERF.md finding 4).
+        y = ConvNormAct(self._w(self.stem_features), strides=(2, 2), **common)(
+            x, train
+        )
         y = nn.max_pool(y, (3, 3), strides=(2, 2), padding="SAME")
         low_level = None
         # Stage strides for output_stride 16: (1, 2, 2→dilated); for 8 the
@@ -195,6 +202,6 @@ class DeepLabV3Plus(nn.Module):
         y = ConvNormAct(self._w(self.decoder_features), **common)(y, train)
         y = ConvNormAct(self._w(self.decoder_features), **common)(y, train)
         logits = nn.Conv(
-            self.num_classes, (1, 1), dtype=jnp.float32, param_dtype=jnp.float32
-        )(y.astype(jnp.float32))
+            self.num_classes, (1, 1), dtype=self.head_dtype, param_dtype=jnp.float32
+        )(y.astype(self.head_dtype))
         return _resize_to(logits, in_hw)

@@ -65,6 +65,7 @@ class ConvNormAct(nn.Module):
 
     features: int
     kernel_size: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
     dilation: int = 1
     norm: str = "batch"
     norm_axis_name: Optional[str] = None
@@ -76,6 +77,7 @@ class ConvNormAct(nn.Module):
         x = nn.Conv(
             self.features,
             self.kernel_size,
+            strides=self.strides,
             padding="SAME",
             kernel_dilation=(self.dilation, self.dilation),
             use_bias=self.norm == "none",

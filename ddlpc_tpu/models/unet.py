@@ -38,13 +38,15 @@ class UNet(nn.Module):
     stem: str = "none"  # none | s2d (see ModelConfig.stem)
     stem_factor: int = 2
     dtype: Any = jnp.bfloat16
+    head_dtype: Any = jnp.float32  # see ModelConfig.head_dtype
 
     def _w(self, f: int) -> int:
         return max(1, f // self.width_divisor)
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
-        """x: [N, H, W, C] float; returns logits [N, H, W, num_classes] float32."""
+        """x: [N, H, W, C] float; returns logits [N, H, W, num_classes] in
+        ``head_dtype`` (float32 by default)."""
         x = x.astype(self.dtype)
         if self.stem == "s2d":
             # Run the whole pyramid at 1/r resolution on r²-richer channels;
@@ -73,9 +75,9 @@ class UNet(nn.Module):
         logits = nn.Conv(
             head_classes,
             (1, 1),
-            dtype=jnp.float32,
+            dtype=self.head_dtype,
             param_dtype=jnp.float32,
-        )(x.astype(jnp.float32))
+        )(x.astype(self.head_dtype))
         if self.stem == "s2d":
             logits = depth_to_space(logits, self.stem_factor)
         return logits

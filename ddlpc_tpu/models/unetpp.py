@@ -37,6 +37,7 @@ class UNetPP(nn.Module):
     norm_groups: int = 8
     deep_supervision: bool = True
     dtype: Any = jnp.bfloat16
+    head_dtype: Any = jnp.float32  # see ModelConfig.head_dtype
 
     def _w(self, f: int) -> int:
         return max(1, f // self.width_divisor)
@@ -45,7 +46,8 @@ class UNetPP(nn.Module):
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
         """x: [N,H,W,C] float; H, W divisible by 2**(len(features)-1).
 
-        Returns float32 logits: [N,H,W,num_classes] — except with deep
+        Returns logits in ``head_dtype`` (float32 by default):
+        [N,H,W,num_classes] — except with deep
         supervision under ``train=True``, where the stacked per-head logits
         [J,N,H,W,num_classes] come back so the loss averages per-head
         cross-entropies (losses broadcast labels over leading axes, so
@@ -82,14 +84,19 @@ class UNetPP(nn.Module):
             return nn.Conv(
                 self.num_classes,
                 (1, 1),
-                dtype=jnp.float32,
+                dtype=self.head_dtype,
                 param_dtype=jnp.float32,
                 name=name,
-            )(h.astype(jnp.float32))
+            )(h.astype(self.head_dtype))
 
         if self.deep_supervision:
             logits = jnp.stack(
                 [head(grid[(0, j)], f"head_{j}") for j in range(1, depth)]
             )
-            return logits if train else jnp.mean(logits, axis=0)
+            # Ensemble-mean readout in fp32 regardless of head storage dtype.
+            return (
+                logits
+                if train
+                else jnp.mean(logits.astype(jnp.float32), axis=0)
+            )
         return head(grid[(0, depth - 1)], "head")

@@ -18,9 +18,27 @@ import jax.numpy as jnp
 def pixel_accuracy(
     logits: jax.Array, labels: jax.Array, ignore_index: Optional[int] = None
 ) -> jax.Array:
-    """Fraction of pixels where argmax(logits) == label (кластер.py:775)."""
-    preds = jnp.argmax(logits, axis=-1)
-    correct = (preds == labels).astype(jnp.float32)
+    """Fraction of pixels where the label's logit is the row max
+    (кластер.py:775 computes mean(argmax(outputs)==Y)).
+
+    Deliberately argmax-free: an explicit argmax over [B,H,W,C] lowers to an
+    iota + s32 reduction with full-size integer intermediates (profiled at
+    ~15% of the Cityscapes train step); comparing the label's logit against
+    the row max fuses into the surrounding elementwise work.  Exact-tie
+    pixels — negligible for fp32 logits but common early in training with
+    bfloat16 heads (ModelConfig.head_dtype), where near-uniform logits round
+    onto identical values — count as 1/#tied rather than 1, i.e. the
+    probability a uniform tie-break picks the label, so bf16 ties cannot
+    inflate the metric.  The eval/mIoU path keeps true argmax
+    (confusion_from_logits)."""
+    logits = logits.astype(jnp.float32)
+    num_classes = logits.shape[-1]
+    labels_clipped = jnp.clip(labels, 0, num_classes - 1).astype(jnp.int32)
+    onehot = labels_clipped[..., None] == jnp.arange(num_classes, dtype=jnp.int32)
+    picked = jnp.sum(jnp.where(onehot, logits, 0.0), axis=-1)
+    row_max = logits.max(axis=-1)
+    ties = jnp.sum((logits == row_max[..., None]).astype(jnp.float32), axis=-1)
+    correct = (picked >= row_max).astype(jnp.float32) / jnp.maximum(ties, 1.0)
     if ignore_index is None:
         return correct.mean()
     valid = (labels != ignore_index).astype(jnp.float32)

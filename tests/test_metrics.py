@@ -20,6 +20,18 @@ def test_pixel_accuracy_matches_reference_formula():
     assert float(acc) == 0.75
 
 
+def test_pixel_accuracy_ties_weighted_not_inflated():
+    """Exact ties (common with bf16 logit heads) count 1/#tied — the uniform
+    tie-break expectation — so they cannot inflate the metric to 1.0."""
+    # Two classes exactly tied at the max, label is one of them.
+    logits = jnp.array([[[1.0, 1.0, 0.0]]])
+    labels = jnp.array([[0]])
+    assert float(pixel_accuracy(logits, labels)) == pytest.approx(0.5)
+    # Label not among the tied max → 0.
+    labels_wrong = jnp.array([[2]])
+    assert float(pixel_accuracy(logits, labels_wrong)) == 0.0
+
+
 def test_confusion_matrix_counts():
     preds = jnp.array([0, 0, 1, 2, 2, 2])
     labels = jnp.array([0, 1, 1, 2, 2, 0])

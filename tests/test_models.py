@@ -138,6 +138,42 @@ def test_unet_s2d_stem_learns(tmp_path, stem_factor):
     assert rec["val_miou"] > 0.5
 
 
+def test_bf16_head_learns(tmp_path):
+    """head_dtype='bfloat16' (the bench configs' setting — it halves the
+    logit head's HBM traffic) must train to the same place as the fp32
+    default: only logit STORAGE rounds, softmax still runs in fp32."""
+    from ddlpc_tpu.config import DataConfig, ExperimentConfig, TrainConfig
+    from ddlpc_tpu.train.trainer import Trainer
+
+    cfg = ExperimentConfig(
+        model=ModelConfig(
+            features=(8, 16), bottleneck_features=16, num_classes=4,
+            stem="s2d", stem_factor=4, head_dtype="bfloat16",
+        ),
+        data=DataConfig(dataset="synthetic", image_size=(64, 64),
+                        synthetic_len=40, test_split=8, num_classes=4),
+        train=TrainConfig(epochs=25, micro_batch_size=1, sync_period=2,
+                          learning_rate=3e-3, dump_images_per_epoch=0,
+                          checkpoint_every_epochs=0),
+        workdir=str(tmp_path),
+    )
+    rec = Trainer(cfg).fit()
+    assert rec["val_miou"] > 0.5
+
+
+def test_bf16_head_returns_bf16_logits():
+    cfg = ModelConfig(
+        features=(8, 16), bottleneck_features=16, num_classes=4,
+        head_dtype="bfloat16",
+    )
+    model = build_model(cfg)
+    x = jnp.zeros((1, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.dtype == jnp.bfloat16
+    assert logits.shape == (1, 32, 32, 4)
+
+
 @pytest.mark.parametrize("deep_supervision", [True, False])
 def test_unetpp_shapes(deep_supervision):
     cfg = ModelConfig(
