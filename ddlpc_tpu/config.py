@@ -182,6 +182,15 @@ class CompressionConfig:
     quantize_local: bool = True
     quantize_mean: bool = True
     transport: str = "simulate"  # simulate | ring
+    # 'nearest' is the reference's deterministic round() (кластер.py:474,487).
+    # 'stochastic' rounds up with probability equal to the fractional part:
+    # E[quantized] == gradient, so the codec adds variance but no bias — the
+    # standard fix for coarse-grid (int8, ±10 levels) convergence drag, which
+    # the committed A/B measured for nearest (docs/QUANTIZATION.md).  The
+    # noise is keyed off the replicated step counter (decorrelated per
+    # replica for the local quantization, shared for the mean), so replicas
+    # stay bit-identical and runs reproducible.
+    rounding: str = "nearest"  # nearest | stochastic
 
 
 @dataclass(frozen=True)

@@ -23,7 +23,7 @@ actually compressed.
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Tuple
+from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -44,21 +44,50 @@ def levels_for(cfg: CompressionConfig) -> int:
     """Level count for a quantizing mode; raises on unknown modes so every
     codec consumer (simulate and ring transport alike) rejects them."""
     if cfg.mode == "int8":
+        if not 0 < cfg.int8_levels <= 127:
+            # ±levels must survive the int8 cast: beyond 127 the cast WRAPS
+            # (200 → -56), silently sign-flipping gradients.
+            raise ValueError(
+                f"int8_levels must be in [1, 127], got {cfg.int8_levels}"
+            )
         return cfg.int8_levels
     if cfg.mode == "float16":
+        if cfg.fp16_levels <= 0:
+            raise ValueError(f"fp16_levels must be positive, got {cfg.fp16_levels}")
         return cfg.fp16_levels
     raise ValueError(f"unknown compression mode {cfg.mode!r}")
 
 
-def quantize_with_scale(x: jax.Array, safe_scale: jax.Array, levels: float) -> jax.Array:
-    """round(x / scale · levels) clipped to ±levels, as fp32 lattice values.
+def quantize_with_scale(
+    x: jax.Array,
+    safe_scale: jax.Array,
+    levels: float,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """x/scale·levels snapped to the integer lattice, clipped to ±levels,
+    as fp32 lattice values.
 
     The one quantization formula, shared by the simulate codec (encode) and
     the ring transport (compressed_allreduce.py) so their loss points cannot
-    drift.  ``safe_scale`` must already be zero-guarded (see encode)."""
-    return jnp.clip(
-        jnp.round(x.astype(jnp.float32) / safe_scale * levels), -levels, levels
-    )
+    drift.  ``safe_scale`` must already be zero-guarded (see encode).
+
+    ``key=None`` → round-to-nearest (the reference's round(), кластер.py:474).
+    With a key → stochastic rounding, floor(v + U[0,1)): unbiased
+    (E[result] == v) at the cost of one extra half-step of worst-case error.
+    """
+    return snap_to_lattice(x.astype(jnp.float32) / safe_scale * levels, levels, key)
+
+
+def snap_to_lattice(
+    scaled: jax.Array, levels: float, key: Optional[jax.Array] = None
+) -> jax.Array:
+    """Snap values already in lattice units to integers, clipped to ±levels
+    (nearest without a key; stochastic floor(v + U[0,1)) with one)."""
+    if key is None:
+        snapped = jnp.round(scaled)
+    else:
+        snapped = jnp.floor(scaled + jax.random.uniform(key, scaled.shape))
+    return jnp.clip(snapped, -levels, levels)
 
 
 def safe_divisor(scale: jax.Array) -> jax.Array:
@@ -79,16 +108,47 @@ def global_absmax(tree: PyTree) -> jax.Array:
     )
 
 
-def encode(tree: PyTree, cfg: CompressionConfig) -> Encoded:
+def _leaf_keys(tree: PyTree, key: Optional[jax.Array]) -> PyTree:
+    """One independent PRNG subkey per leaf (None tree when key is None)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if key is None:
+        return jax.tree_util.tree_unflatten(treedef, [None] * len(leaves))
+    return jax.tree_util.tree_unflatten(
+        treedef, list(jax.random.split(key, len(leaves)))
+    )
+
+
+def rounding_key(cfg: CompressionConfig, key: Optional[jax.Array]):
+    """Resolve the key to pass into quantization for this config: None for
+    nearest rounding; the caller's key for stochastic (raising if absent, so
+    a stochastic config can never silently fall back to biased rounding)."""
+    if cfg.rounding == "nearest":
+        return None
+    if cfg.rounding == "stochastic":
+        if key is None:
+            raise ValueError(
+                "rounding='stochastic' needs a PRNG key (the train step "
+                "derives one from the step counter)"
+            )
+        return key
+    raise ValueError(f"unknown rounding {cfg.rounding!r}")
+
+
+def encode(
+    tree: PyTree, cfg: CompressionConfig, key: Optional[jax.Array] = None
+) -> Encoded:
     """Quantize a gradient pytree.  mode='none' stores fp32 unchanged."""
     scale = global_absmax(tree)
     safe = safe_divisor(scale)
     if cfg.mode == "none":
         return Encoded(scale, jax.tree.map(lambda g: g.astype(jnp.float32), tree))
+    key = rounding_key(cfg, key)
     levels = float(levels_for(cfg))
     out_dtype = jnp.int8 if cfg.mode == "int8" else jnp.float16
     q = jax.tree.map(
-        lambda g: quantize_with_scale(g, safe, levels).astype(out_dtype), tree
+        lambda g, k: quantize_with_scale(g, safe, levels, key=k).astype(out_dtype),
+        tree,
+        _leaf_keys(tree, key),
     )
     return Encoded(scale, q)
 
@@ -103,17 +163,21 @@ def decode(enc: Encoded, cfg: CompressionConfig) -> PyTree:
     )
 
 
-def fake_quantize(tree: PyTree, cfg: CompressionConfig) -> PyTree:
+def fake_quantize(
+    tree: PyTree, cfg: CompressionConfig, key: Optional[jax.Array] = None
+) -> PyTree:
     """encode→decode round trip: injects exactly the codec's information loss
     without materializing wire bytes.  Identity when mode='none'."""
     if cfg.mode == "none":
         return tree
-    return decode(encode(tree, cfg), cfg)
+    return decode(encode(tree, cfg, key=key), cfg)
 
 
 def quantization_error_bound(cfg: CompressionConfig) -> float:
     """Max per-element |decode(encode(g)) - g| as a fraction of the global
-    absmax: half a quantization step."""
+    absmax: half a quantization step for nearest rounding, a full step for
+    stochastic (which trades that worst case for zero bias)."""
     if cfg.mode == "none":
         return 0.0
-    return 0.5 / levels_for(cfg)
+    step = 1.0 / levels_for(cfg)
+    return step if cfg.rounding == "stochastic" else 0.5 * step

@@ -39,7 +39,7 @@ two quantizations) — the same bound as the simulate path with
 
 from __future__ import annotations
 
-from typing import Any, List, Sequence, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -94,6 +94,7 @@ def ring_allreduce_mean_quantized(
     axis_name: str,
     axis_size: int,
     cfg: CompressionConfig,
+    key: Optional[jax.Array] = None,
 ) -> PyTree:
     """Mean ``tree`` across ``axis_name`` with quantized bytes on every hop.
 
@@ -102,18 +103,34 @@ def ring_allreduce_mean_quantized(
     simulate-path codec does ('int8' → ±int8_levels, 'float16' →
     ±fp16_levels); 'none' falls back to an exact `lax.pmean`.
     """
+    from ddlpc_tpu.ops.quantize import (
+        fake_quantize,
+        levels_for,
+        quantize_with_scale,
+        rounding_key,
+        safe_divisor,
+        snap_to_lattice,
+    )
+
     if cfg.mode == "none":
         return lax.pmean(tree, axis_name)
     if not jax.tree_util.tree_leaves(tree):
         return tree
+    key = rounding_key(cfg, key)
+    local_key = mean_key = None
+    if key is not None:
+        local_key, mean_key = jax.random.split(key)
+        # Per-replica noise for the local quantization (correlated noise
+        # would survive the mean at full-step size — see grad_sync.py); the
+        # mean requantization keeps the shared key so the gathered chunks
+        # are bit-identical however they were produced.
+        local_key = jax.random.fold_in(local_key, lax.axis_index(axis_name))
     if axis_size == 1:
         # Single replica: the mean is the identity; apply the codec's two
         # quantization points so semantics match the N>1 path.
-        from ddlpc_tpu.ops.quantize import fake_quantize
-
-        return fake_quantize(fake_quantize(tree, cfg), cfg)
-
-    from ddlpc_tpu.ops.quantize import levels_for, quantize_with_scale, safe_divisor
+        return fake_quantize(
+            fake_quantize(tree, cfg, key=local_key), cfg, key=mean_key
+        )
 
     levels = float(levels_for(cfg))
     flat, shapes, treedef = _flatten(tree)
@@ -125,7 +142,7 @@ def ring_allreduce_mean_quantized(
     safe = safe_divisor(scale)
 
     # Quantize ONCE per replica (client-wire loss point, кластер.py:474-496).
-    q = quantize_with_scale(flat, safe, levels)
+    q = quantize_with_scale(flat, safe, levels, key=local_key)
 
     # Pad so the vector splits into axis_size equal chunks.
     chunk = -(-n // axis_size)  # ceil
@@ -152,10 +169,10 @@ def ring_allreduce_mean_quantized(
     # Mean, then re-quantize ONCE for the broadcast hops (server-rebroadcast
     # loss point, кластер.py:328-396).  |mean| ≤ scale, so the same scale is
     # valid and the gather hops carry signed values ≤ levels: int8 always
-    # suffices here, but we keep ``wdt`` for a single wire format.
-    mean_q = jnp.clip(
-        jnp.round(partial / axis_size), -levels, levels
-    ).astype(wdt)
+    # suffices here, but we keep ``wdt`` for a single wire format.  The mean
+    # is already in lattice units (value·levels/scale), so snap it directly
+    # (nearest or stochastic per the shared key).
+    mean_q = snap_to_lattice(partial / axis_size, levels, key=mean_key).astype(wdt)
 
     # --- ring all-gather of the quantized mean chunks (N-1 hops) -----------
     out = jnp.zeros((axis_size, chunk), wdt)

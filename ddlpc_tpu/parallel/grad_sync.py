@@ -29,7 +29,7 @@ import jax
 from jax import lax
 
 from ddlpc_tpu.config import CompressionConfig
-from ddlpc_tpu.ops.quantize import fake_quantize
+from ddlpc_tpu.ops.quantize import fake_quantize, rounding_key
 
 PyTree = Any
 
@@ -39,6 +39,7 @@ def sync_gradients(
     axis_name: str,
     compression: CompressionConfig,
     axis_size: Optional[int] = None,
+    key: Optional[jax.Array] = None,
 ) -> PyTree:
     """All-reduce-mean local gradients across ``axis_name``.
 
@@ -50,6 +51,11 @@ def sync_gradients(
     ``compression.transport='ring'`` swaps the fp32 pmean for the
     byte-compressed ppermute ring (compressed_allreduce.py), which needs the
     static ``axis_size`` of the mesh axis.
+
+    ``key`` drives stochastic rounding (compression.rounding='stochastic');
+    all replicas must pass the same key (the step builders derive it from
+    the replicated step counter), which keeps the mean-requantization
+    bit-identical across replicas.
     """
     if compression.transport not in ("simulate", "ring"):
         raise ValueError(
@@ -74,11 +80,23 @@ def sync_gradients(
         )
 
         return ring_allreduce_mean_quantized(
-            grads, axis_name, axis_size, compression
+            grads, axis_name, axis_size, compression, key=key
         )
+    if compression.mode != "none":
+        key = rounding_key(compression, key)
+    local_key = mean_key = None
+    if key is not None:
+        local_key, mean_key = jax.random.split(key)
+        # Decorrelate the LOCAL rounding noise across replicas: per-replica
+        # gradients are highly correlated, so a shared draw would make the
+        # rounding errors common-mode and survive the pmean at full-step
+        # size instead of averaging down ~1/√N.  The MEAN key must stay
+        # shared — every replica requantizes the identical mean and must
+        # make identical decisions.
+        local_key = jax.random.fold_in(local_key, lax.axis_index(axis_name))
     if compression.quantize_local:
-        grads = fake_quantize(grads, compression)
+        grads = fake_quantize(grads, compression, key=local_key)
     grads = lax.pmean(grads, axis_name)
     if compression.quantize_mean:
-        grads = fake_quantize(grads, compression)
+        grads = fake_quantize(grads, compression, key=mean_key)
     return grads

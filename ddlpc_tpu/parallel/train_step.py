@@ -183,8 +183,20 @@ def make_train_step(
             lambda x: lax.pmean(x, data_axis), batch_stats
         )
         # The one collective of the step — replaces reference L0–L4.
+        # Stochastic-rounding key: a pure function of the replicated step
+        # counter, so every replica derives the same key (bit-identical
+        # rounding decisions) and resumed runs replay the same noise.
+        rng = (
+            jax.random.fold_in(jax.random.key(0x5EED), state.step)
+            if compression.rounding == "stochastic"
+            else None
+        )
         grads = sync_gradients(
-            grads, data_axis, compression, axis_size=mesh.shape[data_axis]
+            grads,
+            data_axis,
+            compression,
+            axis_size=mesh.shape[data_axis],
+            key=rng,
         )
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
@@ -264,7 +276,12 @@ def make_train_step_gspmd(
         if compression.mode != "none":
             from ddlpc_tpu.ops.quantize import fake_quantize
 
-            grads = fake_quantize(grads, compression)
+            rng = (
+                jax.random.fold_in(jax.random.key(0x5EED), state.step)
+                if compression.rounding == "stochastic"
+                else None
+            )
+            grads = fake_quantize(grads, compression, key=rng)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         metrics = {

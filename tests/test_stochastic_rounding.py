@@ -1,0 +1,136 @@
+"""Stochastic rounding for the gradient codec (CompressionConfig.rounding).
+
+Properties that must hold:
+- unbiasedness: E over keys of decode(encode(g)) == g (the whole point);
+- worst-case error ≤ one full lattice step (vs half for nearest);
+- determinism: the same key gives bit-identical results;
+- a missing key raises instead of silently rounding with bias;
+- the train step runs with stochastic int8 on both transports and the
+  quantized-mean update stays replica-identical.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ddlpc_tpu.config import CompressionConfig
+from ddlpc_tpu.ops.quantize import (
+    encode,
+    decode,
+    fake_quantize,
+    quantization_error_bound,
+)
+
+INT8_SR = CompressionConfig(mode="int8", rounding="stochastic")
+
+
+def test_unbiased_over_keys():
+    rng = np.random.default_rng(0)
+    g = jnp.asarray(rng.normal(size=(400,)).astype(np.float32))
+    trials = 512
+
+    @jax.jit
+    def roundtrip(key):
+        return fake_quantize({"g": g}, INT8_SR, key=key)["g"]
+
+    acc = np.zeros_like(np.asarray(g))
+    for i in range(trials):
+        acc += np.asarray(roundtrip(jax.random.key(i)))
+    mean = acc / trials
+    scale = float(jnp.abs(g).max())
+    step = scale / INT8_SR.int8_levels
+    # Monte-Carlo error of the mean: std ≤ step/2 per trial.
+    tol = 4 * (step / 2) / np.sqrt(trials)
+    np.testing.assert_allclose(mean, np.asarray(g), atol=tol)
+
+
+def test_error_bound_full_step():
+    rng = np.random.default_rng(1)
+    g = jnp.asarray(rng.normal(size=(1000,)).astype(np.float32))
+    out = fake_quantize({"g": g}, INT8_SR, key=jax.random.key(7))["g"]
+    scale = float(jnp.abs(g).max())
+    bound = quantization_error_bound(INT8_SR) * scale + 1e-6
+    assert quantization_error_bound(INT8_SR) == pytest.approx(0.1)
+    assert float(jnp.max(jnp.abs(out - g))) <= bound
+
+
+def test_same_key_is_deterministic_and_keys_differ():
+    g = {"a": jnp.linspace(-1, 1, 64)}
+    r1 = fake_quantize(g, INT8_SR, key=jax.random.key(3))
+    r2 = fake_quantize(g, INT8_SR, key=jax.random.key(3))
+    r3 = fake_quantize(g, INT8_SR, key=jax.random.key(4))
+    np.testing.assert_array_equal(np.asarray(r1["a"]), np.asarray(r2["a"]))
+    assert not np.array_equal(np.asarray(r1["a"]), np.asarray(r3["a"]))
+
+
+def test_int8_levels_beyond_cast_range_rejected():
+    """±levels must survive the int8 cast — beyond 127 the cast wraps and
+    sign-flips gradients, so the config is rejected up front."""
+    with pytest.raises(ValueError, match="127"):
+        encode(
+            {"g": jnp.ones((4,))},
+            CompressionConfig(mode="int8", int8_levels=200),
+        )
+
+
+def test_missing_key_raises():
+    with pytest.raises(ValueError, match="stochastic"):
+        encode({"g": jnp.ones((4,))}, INT8_SR)
+    with pytest.raises(ValueError, match="unknown rounding"):
+        encode(
+            {"g": jnp.ones((4,))},
+            CompressionConfig(mode="int8", rounding="banker"),
+            key=jax.random.key(0),
+        )
+
+
+def test_nearest_path_unchanged_by_key_plumbing():
+    cfg = CompressionConfig(mode="int8")
+    g = {"a": jnp.linspace(-1, 1, 64)}
+    np.testing.assert_array_equal(
+        np.asarray(fake_quantize(g, cfg)["a"]),
+        np.asarray(decode(encode(g, cfg), cfg)["a"]),
+    )
+
+
+@pytest.mark.parametrize("transport", ["simulate", "ring"])
+def test_train_step_stochastic_runs_and_replicas_identical(transport):
+    import optax
+
+    from ddlpc_tpu.config import ExperimentConfig, ModelConfig, ParallelConfig
+    from ddlpc_tpu.models import build_model_from_experiment
+    from ddlpc_tpu.parallel.mesh import make_mesh
+    from ddlpc_tpu.parallel.train_step import create_train_state, make_train_step
+
+    cfg = ExperimentConfig(
+        model=ModelConfig(
+            features=(8,), bottleneck_features=8, num_classes=3, norm="group"
+        )
+    )
+    model = build_model_from_experiment(cfg)
+    mesh = make_mesh(ParallelConfig(data_axis_size=8))
+    tx = optax.adam(1e-3)
+    comp = CompressionConfig(mode="int8", rounding="stochastic", transport=transport)
+    step = make_train_step(model, tx, mesh, comp, donate_state=False)
+    state = create_train_state(model, tx, jax.random.key(0), (1, 16, 16, 3))
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.uniform(size=(2, 8, 16, 16, 3)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 3, size=(2, 8, 16, 16)), jnp.int32)
+    for _ in range(3):
+        state, metrics = step(state, images, labels)
+    assert np.isfinite(float(metrics["loss"]))
+    # Params are replicated state: fetching them would hide a desync only if
+    # sharding claimed replication while devices disagreed — assert via a
+    # second step reproducing identically from the same inputs (the rounding
+    # key is a function of step, so a replay from the same state matches).
+    s1, m1 = step(state, images, labels)
+    s2, m2 = step(state, images, labels)
+    assert float(m1["loss"]) == float(m2["loss"])
+    l1 = jax.tree.leaves(s1.params)
+    l2 = jax.tree.leaves(s2.params)
+    for a, b in zip(l1, l2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
