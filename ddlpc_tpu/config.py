@@ -126,6 +126,15 @@ class TrainConfig:
     # <workdir>/profile); -1 disables.  Replaces the reference's wall-clock
     # print "tracing" (SURVEY §5).
     profile_epoch: int = -1
+    # Failure detection (the reference hangs forever on a dead peer,
+    # кластер.py:215-220; SURVEY §5 "fault handling: none").  > 0 arms a
+    # watchdog thread: if no train-loop heartbeat for this many seconds, it
+    # dumps all thread stacks to stderr + <workdir>/stall.log and, with
+    # stall_action='abort', exits (status 42) so a supervisor restarts the
+    # job — which resumes from the latest checkpoint.  Size it well above a
+    # first-compile + slowest-step bound; 0 disables.
+    stall_timeout_s: float = 0.0
+    stall_action: str = "dump"  # dump | abort
 
 
 @dataclass(frozen=True)

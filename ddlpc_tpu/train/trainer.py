@@ -47,6 +47,7 @@ from ddlpc_tpu.train.observability import (
     maybe_profile,
 )
 from ddlpc_tpu.train.optim import build_optimizer
+from ddlpc_tpu.train.watchdog import StallWatchdog
 
 
 class Trainer:
@@ -139,6 +140,14 @@ class Trainer:
             self._restore_synchronized()
         self.logger = MetricsLogger(self.workdir, run_config_json=cfg.to_json())
         self.timer = StageTimer()
+        # Failure detection (SURVEY §5: the reference has none and hangs
+        # forever on a dead peer).  Armed by fit(); beats come from the
+        # epoch loop's data/step stages.
+        self.watchdog = StallWatchdog(
+            timeout_s=cfg.train.stall_timeout_s,
+            action=cfg.train.stall_action,
+            log_path=os.path.join(self.workdir, "stall.log"),
+        )
 
     def _build_train_step(self):
         cfg = self.cfg
@@ -212,15 +221,18 @@ class Trainer:
             # "data" = host wait for the next uploaded super-batch (overlaps
             # compute via the loader's prefetch); "step" = compiled SPMD
             # step dispatch.
+            self.watchdog.beat("data")
             with self.timer.stage("data"):
                 batch = next(it, None)
             if batch is None:
                 break
+            self.watchdog.beat("step")
             with self.timer.stage("step"):
                 self.state, metrics = self.train_step(self.state, *batch)
             losses.append(metrics["loss"])
             accs.append(metrics["pixel_acc"])
         # One host sync per epoch (metrics stayed on device inside the loop).
+        self.watchdog.beat("epoch_metrics_fetch")
         losses = [float(l) for l in losses]
         accs = [float(a) for a in accs]
         epoch_time = time.perf_counter() - t_epoch
@@ -256,6 +268,7 @@ class Trainer:
             data_axis=self.cfg.parallel.data_axis_name,
             space_axis=self.cfg.parallel.space_axis_name if self.spatial else None,
         ):
+            self.watchdog.beat("eval")
             out = self.eval_step(self.state, images, labels)
             cm += np.asarray(out["confusion"], np.float64)
             loss_sum += float(out["loss_sum"])
@@ -315,19 +328,26 @@ class Trainer:
             )
             self.train_step = self._build_train_step()
         record: Dict[str, float] = {}
-        for epoch in range(self.start_epoch, epochs):
-            with maybe_profile(
-                os.path.join(self.workdir, "profile"),
-                enabled=epoch == cfg.profile_epoch,
-            ):
-                record = self.train_epoch(epoch)
-            if cfg.eval_every_epochs and (epoch + 1) % cfg.eval_every_epochs == 0:
-                record.update(self.evaluate())
-            self.logger.log(record)
-            if cfg.checkpoint_every_epochs and (
-                epoch + 1
-            ) % cfg.checkpoint_every_epochs == 0:
-                self.save(epoch)
-            if cfg.dump_images_per_epoch:
-                self.dump_images(epoch)
+        with self.watchdog:
+            for epoch in range(self.start_epoch, epochs):
+                with maybe_profile(
+                    os.path.join(self.workdir, "profile"),
+                    enabled=epoch == cfg.profile_epoch,
+                ):
+                    record = self.train_epoch(epoch)
+                if cfg.eval_every_epochs and (epoch + 1) % cfg.eval_every_epochs == 0:
+                    # evaluate() beats per batch; per-batch eval cost is
+                    # step-like, so the step-sized timeout applies.
+                    record.update(self.evaluate())
+                self.logger.log(record)
+                if cfg.checkpoint_every_epochs and (
+                    epoch + 1
+                ) % cfg.checkpoint_every_epochs == 0:
+                    # Serialization/IO time is unrelated to the step-sized
+                    # timeout — suspend detection rather than mis-size it.
+                    with self.watchdog.paused("checkpoint"):
+                        self.save(epoch)
+                if cfg.dump_images_per_epoch:
+                    with self.watchdog.paused("image_dump"):
+                        self.dump_images(epoch)
         return record

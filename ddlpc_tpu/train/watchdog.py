@@ -1,0 +1,161 @@
+"""Stall watchdog — bounded failure detection for training runs.
+
+The reference has no failure handling at all (SURVEY §5): a dead peer leaves
+the server blocked in ``recv`` forever (кластер.py:215-220) and an EOF turns
+into a crash two frames later (кластер.py:99-100).  The SPMD equivalent of
+that pathology is a hung collective: one lost host and every other process
+in the mesh waits in the runtime, silently, indefinitely.
+
+A watchdog cannot *recover* a lost SPMD peer (the mesh is static by
+construction — that is what makes the collectives fast), but it can turn an
+unbounded silent hang into a bounded, diagnosable failure:
+
+- the training loop ``beat()``s on every data fetch and step dispatch;
+- a daemon thread checks the heartbeat's age; past ``timeout_s`` it writes a
+  diagnosis (last beat tag + age + the Python stacks of every thread, via
+  ``faulthandler``) to stderr and ``<workdir>/stall.log``;
+- ``action='abort'`` then exits the process with a distinctive status so a
+  supervisor (the cluster scheduler that launched the job) can restart it —
+  which resumes from the latest checkpoint (train/checkpoint.py): detect →
+  die → restart → resume is the recovery story, matching how static-mesh
+  TPU training recovers in practice.
+
+The default ``action='dump'`` only diagnoses (repeating at most once per
+timeout window), which is the safe default for interactive runs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import faulthandler
+import os
+import sys
+import threading
+import time
+from typing import Callable, Iterator, Optional
+
+
+class StallWatchdog:
+    """Detects when a heartbeat goes quiet for longer than ``timeout_s``.
+
+    Use as a context manager around the training loop; call :meth:`beat`
+    from the loop.  ``timeout_s <= 0`` disables everything (no thread).
+    """
+
+    def __init__(
+        self,
+        timeout_s: float,
+        action: str = "dump",  # dump | abort
+        log_path: Optional[str] = None,
+        on_stall: Optional[Callable[[float, str], None]] = None,
+        exit_code: int = 42,
+        _exit=os._exit,  # injectable for tests
+    ):
+        if action not in ("dump", "abort"):
+            raise ValueError(f"unknown watchdog action {action!r}")
+        self.timeout_s = float(timeout_s)
+        self.action = action
+        self.log_path = log_path
+        self.on_stall = on_stall
+        self.exit_code = exit_code
+        self._exit = _exit
+        self._last = time.monotonic()
+        self._tag = "init"
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._pause_depth = 0
+        self._pause_lock = threading.Lock()
+        self.stall_count = 0
+
+    # -- heartbeat ---------------------------------------------------------
+
+    def beat(self, tag: str = "") -> None:
+        """Mark liveness.  ``tag`` names the phase for the diagnosis line."""
+        self._last = time.monotonic()
+        if tag:
+            self._tag = tag
+
+    @contextlib.contextmanager
+    def paused(self, tag: str = "paused") -> Iterator[None]:
+        """Suspend stall detection for a legitimately long, unbeaten phase
+        (full-set evaluation, checkpoint serialization, image dumps) whose
+        duration is unrelated to the per-step timeout.  Nests; re-arms with
+        a fresh heartbeat on exit."""
+        with self._pause_lock:
+            self._pause_depth += 1
+        self._tag = tag
+        try:
+            yield
+        finally:
+            with self._pause_lock:
+                self._pause_depth -= 1
+            self.beat(f"after_{tag}")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "StallWatchdog":
+        if self.timeout_s > 0 and self._thread is None:
+            self.beat("start")
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="stall-watchdog", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "StallWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- internals ---------------------------------------------------------
+
+    def _run(self) -> None:
+        poll = max(self.timeout_s / 10.0, 0.05)
+        while not self._stop.wait(poll):
+            with self._pause_lock:
+                if self._pause_depth > 0:
+                    continue
+            age = time.monotonic() - self._last
+            if age < self.timeout_s:
+                continue
+            self.stall_count += 1
+            self._diagnose(age)
+            if self.on_stall is not None:
+                self.on_stall(age, self._tag)
+            if self.action == "abort":
+                self._exit(self.exit_code)
+            # dump mode: rearm so the next window diagnoses again rather
+            # than spinning a report per poll tick.
+            self.beat()
+
+    def _diagnose(self, age: float) -> None:
+        msg = (
+            f"[watchdog] no heartbeat for {age:.1f}s "
+            f"(timeout {self.timeout_s:.1f}s); last phase: {self._tag!r}. "
+            f"Process {os.getpid()} thread stacks follow."
+        )
+        streams = [sys.stderr]
+        fh = None
+        try:
+            if self.log_path:
+                fh = open(self.log_path, "a")
+                streams.append(fh)
+            for s in streams:
+                print(msg, file=s, flush=True)
+                try:
+                    # All-thread Python stacks: shows whether the loop is
+                    # stuck in a device fetch, a collective, or host code.
+                    faulthandler.dump_traceback(file=s)
+                except Exception:
+                    pass
+        finally:
+            if fh is not None:
+                fh.close()

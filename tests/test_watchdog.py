@@ -1,0 +1,127 @@
+"""Stall watchdog (train/watchdog.py) — the failure-detection subsystem the
+reference lacks entirely (SURVEY §5: a dead peer hangs the server forever,
+кластер.py:215-220)."""
+
+import time
+
+import pytest
+
+from ddlpc_tpu.train.watchdog import StallWatchdog
+
+
+def test_fires_on_stall_with_tag_and_log(tmp_path, capsys):
+    log = tmp_path / "stall.log"
+    fired = []
+    wd = StallWatchdog(
+        timeout_s=0.3,
+        log_path=str(log),
+        on_stall=lambda age, tag: fired.append((age, tag)),
+    )
+    with wd:
+        wd.beat("step")
+        deadline = time.monotonic() + 5.0
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.05)
+    assert fired, "watchdog never fired on a stalled heartbeat"
+    age, tag = fired[0]
+    assert age >= 0.3
+    assert tag == "step"
+    text = log.read_text()
+    assert "no heartbeat" in text
+    # The diagnosis includes thread stacks (faulthandler output).
+    assert "Thread" in text or "File" in text
+
+
+def test_beating_prevents_firing():
+    fired = []
+    wd = StallWatchdog(timeout_s=0.4, on_stall=lambda a, t: fired.append(a))
+    with wd:
+        for _ in range(15):
+            wd.beat("loop")
+            time.sleep(0.05)
+    assert not fired
+    assert wd.stall_count == 0
+
+
+def test_abort_action_calls_exit_with_status(tmp_path):
+    exits = []
+    wd = StallWatchdog(
+        timeout_s=0.2,
+        action="abort",
+        log_path=str(tmp_path / "s.log"),
+        _exit=lambda code: exits.append(code),
+    )
+    with wd:
+        deadline = time.monotonic() + 5.0
+        while not exits and time.monotonic() < deadline:
+            time.sleep(0.05)
+    assert exits and exits[0] == 42
+
+
+def test_disabled_when_timeout_nonpositive():
+    wd = StallWatchdog(timeout_s=0.0)
+    with wd:
+        assert wd._thread is None  # no thread ever started
+
+
+def test_unknown_action_rejected():
+    with pytest.raises(ValueError, match="action"):
+        StallWatchdog(timeout_s=1.0, action="restart")
+
+
+def test_dump_mode_rearms_instead_of_spamming():
+    fired = []
+    wd = StallWatchdog(timeout_s=0.2, on_stall=lambda a, t: fired.append(a))
+    with wd:
+        time.sleep(0.55)  # ~2 windows after the rearm
+    assert 1 <= len(fired) <= 3
+
+
+def test_paused_suppresses_firing_and_rearms():
+    fired = []
+    wd = StallWatchdog(timeout_s=0.25, on_stall=lambda a, t: fired.append(t))
+    with wd:
+        with wd.paused("checkpoint"):
+            time.sleep(0.7)  # well past timeout: must NOT fire
+        assert not fired
+        deadline = time.monotonic() + 5.0
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.05)  # resumed: must fire again eventually
+    assert fired and fired[0] == "after_checkpoint"
+
+
+def test_trainer_runs_with_watchdog_armed(tmp_path):
+    """End-to-end: a short training run with a generous timeout must train
+    normally (no spurious stalls) and stop the watchdog thread on exit."""
+    from ddlpc_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+    from ddlpc_tpu.train.trainer import Trainer
+
+    cfg = ExperimentConfig(
+        model=ModelConfig(features=(8,), bottleneck_features=8, num_classes=3),
+        data=DataConfig(
+            dataset="synthetic",
+            image_size=(32, 32),
+            synthetic_len=12,
+            test_split=4,
+            num_classes=3,
+        ),
+        train=TrainConfig(
+            epochs=1,
+            micro_batch_size=1,
+            sync_period=2,
+            dump_images_per_epoch=0,
+            checkpoint_every_epochs=0,
+            stall_timeout_s=300.0,
+        ),
+        workdir=str(tmp_path),
+    )
+    trainer = Trainer(cfg)
+    rec = trainer.fit()
+    assert rec["loss"] == rec["loss"]  # finite-ish: trained at all
+    assert trainer.watchdog.stall_count == 0
+    assert trainer.watchdog._thread is None  # stopped after fit
