@@ -200,6 +200,12 @@ class CompressionConfig:
     # replica for the local quantization, shared for the mean), so replicas
     # stay bit-identical and runs reproducible.
     rounding: str = "nearest"  # nearest | stochastic
+    # Which implementation runs the quantize→dequantize element work on the
+    # simulate transport: 'xla' (default — traces show XLA fuses it to
+    # ~bandwidth already, docs/PERF.md) or 'pallas' (fused single-pass TPU
+    # kernel with hardware-PRNG stochastic rounding, ops/pallas_quantize.py).
+    # The ring transport keeps its own inlined formula either way.
+    codec_backend: str = "xla"  # xla | pallas
 
 
 @dataclass(frozen=True)

@@ -23,6 +23,7 @@ round-trips.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Optional
 
 import jax
@@ -32,6 +33,27 @@ from ddlpc_tpu.config import CompressionConfig
 from ddlpc_tpu.ops.quantize import fake_quantize, rounding_key
 
 PyTree = Any
+
+
+def resolve_codec_backend(compression: CompressionConfig):
+    """The fake-quantize implementation for the simulate transport: the XLA
+    tree transform, or the fused Pallas kernel (interpreted off-TPU so the
+    CPU test meshes exercise the same code path)."""
+    if compression.codec_backend == "pallas":
+        from ddlpc_tpu.ops.pallas_quantize import (
+            default_interpret,
+            fake_quantize_pallas,
+        )
+
+        return functools.partial(
+            fake_quantize_pallas, interpret=default_interpret()
+        )
+    if compression.codec_backend == "xla":
+        return fake_quantize
+    raise ValueError(
+        f"unknown codec_backend {compression.codec_backend!r} "
+        "(expected 'xla' or 'pallas')"
+    )
 
 
 def sync_gradients(
@@ -82,6 +104,7 @@ def sync_gradients(
         return ring_allreduce_mean_quantized(
             grads, axis_name, axis_size, compression, key=key
         )
+    fq = resolve_codec_backend(compression)
     if compression.mode != "none":
         key = rounding_key(compression, key)
     local_key = mean_key = None
@@ -95,8 +118,8 @@ def sync_gradients(
         # make identical decisions.
         local_key = jax.random.fold_in(local_key, lax.axis_index(axis_name))
     if compression.quantize_local:
-        grads = fake_quantize(grads, compression, key=local_key)
+        grads = fq(grads, compression, key=local_key)
     grads = lax.pmean(grads, axis_name)
     if compression.quantize_mean:
-        grads = fake_quantize(grads, compression, key=mean_key)
+        grads = fq(grads, compression, key=mean_key)
     return grads

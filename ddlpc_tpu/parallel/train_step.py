@@ -274,14 +274,16 @@ def make_train_step_gspmd(
             model, state, images, labels, remat=remat
         )
         if compression.mode != "none":
-            from ddlpc_tpu.ops.quantize import fake_quantize
+            from ddlpc_tpu.parallel.grad_sync import resolve_codec_backend
 
             rng = (
                 jax.random.fold_in(jax.random.key(0x5EED), state.step)
                 if compression.rounding == "stochastic"
                 else None
             )
-            grads = fake_quantize(grads, compression, key=rng)
+            grads = resolve_codec_backend(compression)(
+                grads, compression, key=rng
+            )
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         metrics = {

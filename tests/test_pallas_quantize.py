@@ -1,0 +1,144 @@
+"""Pallas fused codec kernel (ops/pallas_quantize.py), interpret mode.
+
+The CPU suite runs the kernel through the Pallas interpreter: identical
+grid/block/snap logic to the TPU lowering, with host-drawn noise replacing
+the TPU hardware PRNG (which has no interpreter lowering).  On-chip
+validation (1-ulp nearest parity vs XLA, hw-PRNG error bound/determinism/
+unbiasedness, device-time comparison) is recorded in docs/PERF.md.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddlpc_tpu.config import CompressionConfig
+from ddlpc_tpu.ops.pallas_quantize import LANES, fake_quantize_pallas
+from ddlpc_tpu.ops.quantize import fake_quantize
+
+
+@pytest.mark.parametrize("mode", ["int8", "float16"])
+def test_nearest_matches_xla_codec_exactly(mode):
+    rng = np.random.default_rng(0)
+    # Ragged sizes: smaller than one row, non-multiple of LANES, multi-dim.
+    tree = {
+        "tiny": jnp.asarray(rng.normal(size=(17,)), jnp.float32),
+        "row+": jnp.asarray(rng.normal(size=(LANES + 33,)), jnp.float32),
+        "mat": jnp.asarray(rng.normal(size=(13, 57)), jnp.float32),
+    }
+    cfg = CompressionConfig(mode=mode)
+    ref = fake_quantize(tree, cfg)
+    out = fake_quantize_pallas(tree, cfg, interpret=True)
+    for k in tree:
+        # Lattice points themselves are exact; the dequant multiply may
+        # contract differently (FMA) between the two compilers — allow the
+        # single ulp that costs, nothing more.
+        np.testing.assert_allclose(
+            np.asarray(ref[k]), np.asarray(out[k]), rtol=3e-7, atol=0
+        )
+
+
+def test_mode_none_is_identity():
+    tree = {"a": jnp.ones((5,))}
+    assert fake_quantize_pallas(tree, CompressionConfig(mode="none")) is tree
+
+
+def test_stochastic_interpret_bound_and_determinism():
+    cfg = CompressionConfig(mode="int8", rounding="stochastic")
+    rng = np.random.default_rng(1)
+    tree = {"g": jnp.asarray(rng.normal(size=(3000,)), jnp.float32)}
+    out = fake_quantize_pallas(tree, cfg, key=jax.random.key(3), interpret=True)
+    scale = float(jnp.abs(tree["g"]).max())
+    assert float(jnp.abs(out["g"] - tree["g"]).max()) <= scale / 10 + 1e-6
+    out2 = fake_quantize_pallas(tree, cfg, key=jax.random.key(3), interpret=True)
+    np.testing.assert_array_equal(np.asarray(out["g"]), np.asarray(out2["g"]))
+
+
+def test_stochastic_requires_key():
+    cfg = CompressionConfig(mode="int8", rounding="stochastic")
+    with pytest.raises(ValueError, match="stochastic"):
+        fake_quantize_pallas({"g": jnp.ones((4,))}, cfg, interpret=True)
+
+
+def test_grad_sync_pallas_backend_trains():
+    """The codec_backend='pallas' path runs inside the full shard_map train
+    step on the 8-device mesh (interpret mode on CPU)."""
+    import optax
+
+    from ddlpc_tpu.config import ExperimentConfig, ModelConfig, ParallelConfig
+    from ddlpc_tpu.models import build_model_from_experiment
+    from ddlpc_tpu.parallel.mesh import make_mesh
+    from ddlpc_tpu.parallel.train_step import create_train_state, make_train_step
+
+    cfg = ExperimentConfig(
+        model=ModelConfig(
+            features=(8,), bottleneck_features=8, num_classes=3, norm="group"
+        )
+    )
+    model = build_model_from_experiment(cfg)
+    mesh = make_mesh(ParallelConfig(data_axis_size=8))
+    tx = optax.adam(1e-3)
+    comp = CompressionConfig(mode="int8", codec_backend="pallas")
+    step = make_train_step(model, tx, mesh, comp, donate_state=False)
+    state = create_train_state(model, tx, jax.random.key(0), (1, 16, 16, 3))
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.uniform(size=(2, 8, 16, 16, 3)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 3, size=(2, 8, 16, 16)), jnp.int32)
+    state, metrics = step(state, images, labels)
+    assert np.isfinite(float(metrics["loss"]))
+    # Same data, same state → the XLA backend computes the same update
+    # (nearest rounding is deterministic; kernels agree to <=1 ulp on the
+    # lattice, and lattice values themselves are exact).
+    comp_x = CompressionConfig(mode="int8", codec_backend="xla")
+    step_x = make_train_step(model, tx, mesh, comp_x, donate_state=False)
+    state_x = create_train_state(model, tx, jax.random.key(0), (1, 16, 16, 3))
+    state_x, metrics_x = step_x(state_x, images, labels)
+    assert float(metrics["loss"]) == pytest.approx(float(metrics_x["loss"]), rel=1e-6)
+
+
+def test_gspmd_step_honors_pallas_backend():
+    """The GSPMD step resolves codec_backend too (it has its own quantize
+    point) — an unknown backend must raise there, and 'pallas' must run."""
+    import optax
+
+    from ddlpc_tpu.config import ExperimentConfig, ModelConfig, ParallelConfig
+    from ddlpc_tpu.models import build_model_from_experiment
+    from ddlpc_tpu.parallel.mesh import make_mesh
+    from ddlpc_tpu.parallel.train_step import create_train_state, make_train_step_gspmd
+
+    cfg = ExperimentConfig(
+        model=ModelConfig(
+            features=(8,), bottleneck_features=8, num_classes=3, norm="group"
+        )
+    )
+    model = build_model_from_experiment(cfg)
+    mesh = make_mesh(ParallelConfig(data_axis_size=4, space_axis_size=2))
+    tx = optax.adam(1e-3)
+    comp = CompressionConfig(mode="int8", codec_backend="pallas")
+    step = make_train_step_gspmd(model, tx, mesh, comp, donate_state=False)
+    state = create_train_state(model, tx, jax.random.key(0), (1, 16, 16, 3))
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.uniform(size=(2, 4, 16, 16, 3)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 3, size=(2, 4, 16, 16)), jnp.int32)
+    state, metrics = step(state, images, labels)
+    assert np.isfinite(float(metrics["loss"]))
+    with pytest.raises(ValueError, match="codec_backend"):
+        make_train_step_gspmd(
+            model,
+            tx,
+            mesh,
+            CompressionConfig(mode="int8", codec_backend="triton"),
+            donate_state=False,
+        )(state, images, labels)
+
+
+def test_unknown_backend_rejected():
+    from ddlpc_tpu.parallel.grad_sync import sync_gradients
+
+    with pytest.raises(ValueError, match="codec_backend"):
+        sync_gradients(
+            {"w": jnp.ones((4,))},
+            "data",
+            CompressionConfig(mode="int8", codec_backend="triton"),
+            axis_size=8,
+        )
