@@ -84,6 +84,10 @@ def sync_gradients(
             f"unknown compression transport {compression.transport!r} "
             "(expected 'simulate' or 'ring')"
         )
+    # Validate codec_backend up front on every path: the ring inlines its own
+    # formula (backend-independent), but a typo'd backend must not be
+    # silently accepted on one transport and rejected on the other.
+    fq = resolve_codec_backend(compression)
     if compression.transport == "ring" and compression.mode != "none":
         if axis_size is None:
             raise ValueError(
@@ -104,7 +108,6 @@ def sync_gradients(
         return ring_allreduce_mean_quantized(
             grads, axis_name, axis_size, compression, key=key
         )
-    fq = resolve_codec_backend(compression)
     if compression.mode != "none":
         key = rounding_key(compression, key)
     local_key = mean_key = None

@@ -86,14 +86,18 @@ def test_grad_sync_pallas_backend_trains():
     labels = jnp.asarray(rng.integers(0, 3, size=(2, 8, 16, 16)), jnp.int32)
     state, metrics = step(state, images, labels)
     assert np.isfinite(float(metrics["loss"]))
-    # Same data, same state → the XLA backend computes the same update
+    # Same data, same init → the XLA backend computes the same UPDATE
     # (nearest rounding is deterministic; kernels agree to <=1 ulp on the
-    # lattice, and lattice values themselves are exact).
+    # lattice).  Compare post-step params — the step's reported loss is the
+    # pre-update forward pass and would match even with a broken codec.
     comp_x = CompressionConfig(mode="int8", codec_backend="xla")
     step_x = make_train_step(model, tx, mesh, comp_x, donate_state=False)
     state_x = create_train_state(model, tx, jax.random.key(0), (1, 16, 16, 3))
-    state_x, metrics_x = step_x(state_x, images, labels)
-    assert float(metrics["loss"]) == pytest.approx(float(metrics_x["loss"]), rel=1e-6)
+    state_x, _ = step_x(state_x, images, labels)
+    for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(state_x.params)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32), atol=1e-6
+        )
 
 
 def test_gspmd_step_honors_pallas_backend():
