@@ -105,6 +105,11 @@ class TrainConfig:
     learning_rate: float = 1e-3  # torch.optim.Adam default, as the reference uses (кластер.py:704)
     optimizer: str = "adam"
     weight_decay: float = 0.0
+    # Global-norm gradient clipping applied AFTER the cross-replica mean and
+    # codec (every replica sees the identical gradient, so the clip factor
+    # is identical too — replicated updates stay bit-identical).  0 = off,
+    # the reference's behavior (no clipping anywhere).
+    grad_clip_norm: float = 0.0
     # 'constant' (reference behavior: fixed default-LR Adam, кластер.py:704)
     # or 'cosine' (linear warmup over warmup_steps, cosine decay to 0 over
     # the run's total optimizer steps — the Trainer supplies the horizon).

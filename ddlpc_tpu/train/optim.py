@@ -55,4 +55,13 @@ def build_optimizer(
         raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
     if cfg.weight_decay and cfg.optimizer == "adam":
         tx = optax.chain(optax.add_decayed_weights(cfg.weight_decay), tx)
+    if cfg.grad_clip_norm:
+        if cfg.grad_clip_norm < 0:
+            raise ValueError(
+                f"grad_clip_norm must be >= 0, got {cfg.grad_clip_norm}"
+            )
+        # Clip first, then the optimizer sees bounded gradients.  This runs
+        # inside the compiled step after sync_gradients, so the global norm
+        # is of the already-averaged (and codec-processed) gradient.
+        tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), tx)
     return tx

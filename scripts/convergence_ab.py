@@ -21,6 +21,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -59,6 +62,7 @@ def run_variant(
     micro_batch=8,
     sync_period=4,
     seed=0,
+    rounding: str = "nearest",
 ) -> dict:
     cfg = ExperimentConfig(
         model=ModelConfig(
@@ -75,7 +79,7 @@ def run_variant(
             seed=seed,
         ),
         parallel=ParallelConfig(),
-        compression=CompressionConfig(mode=mode),
+        compression=CompressionConfig(mode=mode, rounding=rounding),
     )
     mesh = make_mesh(cfg.parallel)
     n_dev = mesh.shape["data"]
@@ -173,6 +177,12 @@ def main() -> None:
     p.add_argument("--stem-for-modes", type=int, default=4)
     p.add_argument("--epochs", type=int, default=40)
     p.add_argument("--outdir", default="runs/convergence_ab")
+    p.add_argument(
+        "--roundings",
+        default="",
+        help="comma list, e.g. nearest,stochastic — A/Bs the int8 codec's "
+        "rounding rule at full 512² scale (docs/QUANTIZATION.md)",
+    )
     args = p.parse_args()
 
     results = []
@@ -194,8 +204,28 @@ def main() -> None:
             )
         )
         print(json.dumps(results[-1]))
-    with open(os.path.join(args.outdir, "summary.json"), "w") as f:
-        json.dump(results, f, indent=2)
+    for rounding in [r for r in args.roundings.split(",") if r]:
+        results.append(
+            run_variant(
+                f"int8_{rounding}_stem{args.stem_for_modes}",
+                args.stem_for_modes,
+                "int8",
+                args.epochs,
+                args.outdir,
+                rounding=rounding,
+            )
+        )
+        print(json.dumps(results[-1]))
+    # Merge by tag into any existing summary: partial reruns (one study)
+    # must not delete the other studies' committed headline entries.
+    summary_path = os.path.join(args.outdir, "summary.json")
+    merged = {}
+    if os.path.exists(summary_path):
+        with open(summary_path) as f:
+            merged = {r["tag"]: r for r in json.load(f)}
+    merged.update({r["tag"]: r for r in results})
+    with open(summary_path, "w") as f:
+        json.dump(list(merged.values()), f, indent=2)
 
 
 if __name__ == "__main__":

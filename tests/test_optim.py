@@ -108,3 +108,24 @@ def test_trainer_cosine_end_to_end(tmp_path):
     assert channels == 3
     out = logits_fn(state, np.zeros((1, 16, 16, 3), np.float32))
     assert out.shape == (1, 16, 16, 4)
+
+
+def test_grad_clip_norm_bounds_update():
+    """grad_clip_norm rescales the gradient to the cap before Adam sees it:
+    a 1000x gradient spike must produce the same step direction at bounded
+    magnitude, and the config validates negative values."""
+    params = {"w": jnp.zeros((4,))}
+    g_spike = {"w": jnp.full((4,), 1000.0)}
+    tx = build_optimizer(
+        TrainConfig(optimizer="sgd", learning_rate=1.0, grad_clip_norm=1.0)
+    )
+    state = tx.init(params)
+    updates, _ = tx.update(g_spike, state, params)
+    norm = float(optax.global_norm(updates))
+    assert norm == pytest.approx(1.0, rel=1e-5)  # clipped to the cap
+    # Unclipped control actually moves 2000x further.
+    tx0 = build_optimizer(TrainConfig(optimizer="sgd", learning_rate=1.0))
+    u0, _ = tx0.update(g_spike, tx0.init(params), params)
+    assert float(optax.global_norm(u0)) == pytest.approx(2000.0, rel=1e-5)
+    with pytest.raises(ValueError, match="grad_clip_norm"):
+        build_optimizer(TrainConfig(grad_clip_norm=-1.0))
