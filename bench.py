@@ -83,9 +83,10 @@ BENCHES = {
             head_dtype="bfloat16",
         ),
         image=(512, 512),
-        # B=64/chip fits v5e HBM with the factor-4 stem (B=96 also fits and
-        # is ~19% faster still; 64 keeps headroom) — see docs/PERF.md sweep.
-        micro_batch=64,
+        # Sweep with the bf16 head (docs/PERF.md): 64→1400, 96→1600,
+        # 128→1778, 160→1355 (HBM pressure).  128 is the measured optimum
+        # and 160 still runs, so 128 keeps real headroom.
+        micro_batch=128,
         sync_period=4,
         compression="float16",
     ),

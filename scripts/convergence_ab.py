@@ -205,16 +205,30 @@ def main() -> None:
         )
         print(json.dumps(results[-1]))
     for rounding in [r for r in args.roundings.split(",") if r]:
-        results.append(
-            run_variant(
-                f"int8_{rounding}_stem{args.stem_for_modes}",
+        tag = f"int8_{rounding}_stem{args.stem_for_modes}"
+        src_tag = f"mode_int8_stem{args.stem_for_modes}"
+        src = next((r for r in results if r["tag"] == src_tag), None)
+        if rounding == "nearest" and src is not None:
+            # int8+nearest IS the --modes int8 variant (nearest is the
+            # default rounding): alias instead of re-burning a 40-epoch
+            # accelerator run on identical numbers.
+            import shutil
+
+            rec = dict(src, tag=tag)
+            shutil.copyfile(
+                os.path.join(args.outdir, f"{src_tag}.jsonl"),
+                os.path.join(args.outdir, f"{tag}.jsonl"),
+            )
+        else:
+            rec = run_variant(
+                tag,
                 args.stem_for_modes,
                 "int8",
                 args.epochs,
                 args.outdir,
                 rounding=rounding,
             )
-        )
+        results.append(rec)
         print(json.dumps(results[-1]))
     # Merge by tag into any existing summary: partial reruns (one study)
     # must not delete the other studies' committed headline entries.

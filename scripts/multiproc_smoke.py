@@ -122,8 +122,19 @@ def _attempt(timeout_s: float) -> int:
         )
         for r in range(2)
     ]
+    import time
+
+    deadline = time.monotonic() + timeout_s  # ONE deadline for both ranks:
+    # sequential fresh-per-process timeouts could stack past the pytest
+    # wrapper's own timeout, which kills only this parent and would orphan
+    # the rank processes mid-collective.
     try:
-        rcs = [p.wait(timeout=timeout_s) for p in procs]
+        rcs = [
+            p.wait(timeout=max(deadline - time.monotonic(), 1.0)) for p in procs
+        ]
+    except subprocess.TimeoutExpired:
+        print(f"FAILED: rank hung past {timeout_s}s", file=sys.stderr)
+        return 1
     finally:
         # One rank asserting first deadlocks the other in a collective —
         # never leave orphaned JAX processes spinning on the runner.
