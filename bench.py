@@ -112,6 +112,26 @@ BENCHES = {
         sync_period=4,
         compression="none",
     ),
+    "unetpp_vaihingen512_s2d": dict(
+        # TPU-first U-Net++: the same s2d×4 stem as the flagship applied to
+        # the nested grid — the dense full-width X[0][j] row, the grid's
+        # biggest nodes, runs at 128² on rich channels.  34 → 679
+        # tiles/s/chip (sweep: B=32→419, 48→451, 64→498, 96→679; 128
+        # stalls).  The paper-layout row above stays for honest comparison.
+        model=dict(
+            name="unetpp",
+            num_classes=6,
+            features=(32, 64, 128, 256, 512),
+            deep_supervision=True,
+            head_dtype="bfloat16",
+            stem="s2d",
+            stem_factor=4,
+        ),
+        image=(512, 512),
+        micro_batch=96,
+        sync_period=4,
+        compression="none",
+    ),
     "deeplabv3p_potsdam512": dict(
         model=dict(
             name="deeplabv3p",

@@ -60,6 +60,8 @@ def _build_unetpp(cfg: ModelConfig, norm_axis_name: Optional[str]) -> nn.Module:
         norm_axis_name=norm_axis_name,
         norm_groups=cfg.group_norm_groups,
         deep_supervision=cfg.deep_supervision,
+        stem=cfg.stem,
+        stem_factor=cfg.stem_factor,
         dtype=jnp.dtype(cfg.compute_dtype),
         head_dtype=jnp.dtype(cfg.head_dtype),
     )

@@ -167,6 +167,27 @@ def depth_to_space(x: jax.Array, r: int) -> jax.Array:
     return x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h * r, w * r, c // (r * r))
 
 
+def apply_stem(x: jax.Array, stem: str, factor: int) -> jax.Array:
+    """Shared input-stem dispatch for the zoo: 'none' passes through, 's2d'
+    space-to-depths by ``factor``.  One implementation so U-Net and U-Net++
+    cannot diverge on validation or semantics."""
+    if stem == "s2d":
+        return space_to_depth(x, factor)
+    if stem == "none":
+        return x
+    raise ValueError(f"unknown stem {stem!r}")
+
+
+def head_channels(num_classes: int, stem: str, factor: int) -> int:
+    """Logit-head channel count: ×factor² for subpixel heads under s2d."""
+    return num_classes * factor * factor if stem == "s2d" else num_classes
+
+
+def restore_head(logits: jax.Array, stem: str, factor: int) -> jax.Array:
+    """Inverse of the stem on the logit grid (subpixel upsampling)."""
+    return depth_to_space(logits, factor) if stem == "s2d" else logits
+
+
 def upsample_2x(x: jax.Array, method: str = "bilinear") -> jax.Array:
     """2× spatial upsample of NHWC via jax.image.resize."""
     n, h, w, c = x.shape

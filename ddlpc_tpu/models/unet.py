@@ -21,8 +21,9 @@ from ddlpc_tpu.models.layers import (
     DoubleConv,
     DownBlock,
     UpBlock,
-    depth_to_space,
-    space_to_depth,
+    apply_stem,
+    head_channels,
+    restore_head,
 )
 
 
@@ -45,15 +46,13 @@ class UNet(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
-        """x: [N, H, W, C] float; returns logits [N, H, W, num_classes] in
-        ``head_dtype`` (float32 by default)."""
+        """x: [N, H, W, C] float, H and W divisible by
+        2**len(features) (× ``stem_factor`` with the s2d stem); returns
+        logits [N, H, W, num_classes] in ``head_dtype`` (float32 default)."""
         x = x.astype(self.dtype)
-        if self.stem == "s2d":
-            # Run the whole pyramid at 1/r resolution on r²-richer channels;
-            # logits come back to full resolution through a subpixel head.
-            x = space_to_depth(x, self.stem_factor)
-        elif self.stem != "none":
-            raise ValueError(f"unknown stem {self.stem!r}")
+        # s2d: run the whole pyramid at 1/r resolution on r²-richer
+        # channels; logits return to full resolution via a subpixel head.
+        x = apply_stem(x, self.stem, self.stem_factor)
         common = dict(
             norm=self.norm,
             norm_axis_name=self.norm_axis_name,
@@ -69,15 +68,10 @@ class UNet(nn.Module):
             x = UpBlock(self._w(f), up_sample_mode=self.up_sample_mode, **common)(
                 x, skip, train
             )
-        head_classes = self.num_classes
-        if self.stem == "s2d":
-            head_classes *= self.stem_factor**2
         logits = nn.Conv(
-            head_classes,
+            head_channels(self.num_classes, self.stem, self.stem_factor),
             (1, 1),
             dtype=self.head_dtype,
             param_dtype=jnp.float32,
         )(x.astype(self.head_dtype))
-        if self.stem == "s2d":
-            logits = depth_to_space(logits, self.stem_factor)
-        return logits
+        return restore_head(logits, self.stem, self.stem_factor)

@@ -24,7 +24,14 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from ddlpc_tpu.models.layers import DoubleConv, UpBlock, max_pool_2x2
+from ddlpc_tpu.models.layers import (
+    DoubleConv,
+    UpBlock,
+    apply_stem,
+    head_channels,
+    max_pool_2x2,
+    restore_head,
+)
 
 
 class UNetPP(nn.Module):
@@ -36,6 +43,12 @@ class UNetPP(nn.Module):
     norm_axis_name: Optional[str] = None
     norm_groups: int = 8
     deep_supervision: bool = True
+    # TPU-first s2d stem, same trade as UNet's (layers.py:space_to_depth):
+    # the dense X[0][j] row — the grid's most expensive nodes — runs at
+    # 1/r² the pixels on r²-richer channels, and every supervision head
+    # becomes a subpixel head.  'none' is the paper-layout default.
+    stem: str = "none"  # none | s2d
+    stem_factor: int = 2
     dtype: Any = jnp.bfloat16
     head_dtype: Any = jnp.float32  # see ModelConfig.head_dtype
 
@@ -44,7 +57,8 @@ class UNetPP(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
-        """x: [N,H,W,C] float; H, W divisible by 2**(len(features)-1).
+        """x: [N,H,W,C] float; H, W divisible by 2**(len(features)-1)
+        (× ``stem_factor`` with the s2d stem).
 
         Returns logits in ``head_dtype`` (float32 by default):
         [N,H,W,num_classes] — except with deep
@@ -54,6 +68,7 @@ class UNetPP(nn.Module):
         ``softmax_cross_entropy(stacked, labels)`` IS the mean of the
         per-head losses)."""
         x = x.astype(self.dtype)
+        x = apply_stem(x, self.stem, self.stem_factor)
         depth = len(self.features)
         common = dict(
             norm=self.norm,
@@ -81,13 +96,14 @@ class UNetPP(nn.Module):
                 )(grid[(i + 1, j - 1)], skips, train)
 
         def head(h: jax.Array, name: str) -> jax.Array:
-            return nn.Conv(
-                self.num_classes,
+            logits = nn.Conv(
+                head_channels(self.num_classes, self.stem, self.stem_factor),
                 (1, 1),
                 dtype=self.head_dtype,
                 param_dtype=jnp.float32,
                 name=name,
             )(h.astype(self.head_dtype))
+            return restore_head(logits, self.stem, self.stem_factor)
 
         if self.deep_supervision:
             logits = jnp.stack(

@@ -212,13 +212,14 @@ def main() -> None:
             # int8+nearest IS the --modes int8 variant (nearest is the
             # default rounding): alias instead of re-burning a 40-epoch
             # accelerator run on identical numbers.
-            import shutil
-
             rec = dict(src, tag=tag)
-            shutil.copyfile(
-                os.path.join(args.outdir, f"{src_tag}.jsonl"),
-                os.path.join(args.outdir, f"{tag}.jsonl"),
-            )
+            # Rewrite the per-epoch records' tag too, so consumers grouping
+            # jsonl lines by tag (not filename) attribute them correctly.
+            with open(os.path.join(args.outdir, f"{src_tag}.jsonl")) as fin, open(
+                os.path.join(args.outdir, f"{tag}.jsonl"), "w"
+            ) as fout:
+                for line in fin:
+                    fout.write(json.dumps(dict(json.loads(line), tag=tag)) + "\n")
         else:
             rec = run_variant(
                 tag,

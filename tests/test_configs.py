@@ -86,5 +86,6 @@ def test_config_trains_one_epoch(path, tmp_path):
 
 
 def test_config_files_exist():
-    # The five BASELINE parity configs plus the TPU-first flagship.
-    assert len(CONFIG_FILES) == 6, CONFIG_FILES
+    # The five BASELINE parity configs plus the TPU-first flagship and the
+    # TPU-first U-Net++ (s2d stem — 20× the paper layout's throughput).
+    assert len(CONFIG_FILES) == 7, CONFIG_FILES

@@ -161,6 +161,29 @@ def test_bf16_head_learns(tmp_path):
     assert rec["val_miou"] > 0.5
 
 
+def test_unetpp_s2d_stem_learns(tmp_path):
+    """U-Net++ with the TPU-first s2d stem (the bench's
+    unetpp_vaihingen512_s2d config, 20× the paper layout's throughput) must
+    still converge — deep-supervision subpixel heads included."""
+    from ddlpc_tpu.config import DataConfig, ExperimentConfig, TrainConfig
+    from ddlpc_tpu.train.trainer import Trainer
+
+    cfg = ExperimentConfig(
+        model=ModelConfig(
+            name="unetpp", features=(8, 16), num_classes=4,
+            deep_supervision=True, stem="s2d", stem_factor=4,
+        ),
+        data=DataConfig(dataset="synthetic", image_size=(64, 64),
+                        synthetic_len=40, test_split=8, num_classes=4),
+        train=TrainConfig(epochs=25, micro_batch_size=1, sync_period=2,
+                          learning_rate=3e-3, dump_images_per_epoch=0,
+                          checkpoint_every_epochs=0),
+        workdir=str(tmp_path),
+    )
+    rec = Trainer(cfg).fit()
+    assert rec["val_miou"] > 0.5
+
+
 def test_bf16_head_returns_bf16_logits():
     cfg = ModelConfig(
         features=(8, 16), bottleneck_features=16, num_classes=4,
