@@ -247,7 +247,7 @@ def test_configs_dir_parses():
     from ddlpc_tpu.config import ExperimentConfig
 
     paths = sorted(glob.glob(os.path.join(os.path.dirname(__file__), "..", "configs", "*.json")))
-    assert len(paths) == 6  # 5 BASELINE parity configs + the TPU flagship
+    assert len(paths) == 7  # 5 BASELINE parity + TPU flagship + s2d U-Net++
     for p in paths:
         cfg = ExperimentConfig.from_json(open(p).read())
         assert cfg.model.num_classes == cfg.data.num_classes
