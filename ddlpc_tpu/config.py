@@ -201,9 +201,10 @@ class CompressionConfig:
     # E[quantized] == gradient, so the codec adds variance but no bias — the
     # standard fix for coarse-grid (int8, ±10 levels) convergence drag, which
     # the committed A/B measured for nearest (docs/QUANTIZATION.md).  The
-    # noise is keyed off the replicated step counter (decorrelated per
-    # replica for the local quantization, shared for the mean), so replicas
-    # stay bit-identical and runs reproducible.
+    # noise is keyed off (TrainConfig.seed, replicated step counter) —
+    # decorrelated per replica for the local quantization, shared for the
+    # mean — so replicas stay bit-identical, same-seed runs replay the same
+    # noise, and different seeds draw different noise.
     rounding: str = "nearest"  # nearest | stochastic
     # Which implementation runs the quantize→dequantize element work on the
     # simulate transport: 'xla' (default — traces show XLA fuses it to

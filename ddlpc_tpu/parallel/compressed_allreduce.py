@@ -22,8 +22,10 @@ fp32 `psum` is usually faster and remains the default.
 Quantization semantics (mirroring the reference's two loss points):
 - one *shared* scale = `pmax` of the per-replica global absmax (the
   reference uses each worker's own absmax, кластер.py:463-471; a shared
-  scale is required for integer summation on the wire and is never smaller,
-  so per-element error bounds are unchanged);
+  scale is required for integer summation on the wire — per-element error
+  stays bounded by the shared scale, which may exceed a replica's local
+  absmax and hence enlarge that replica's quantization step vs the
+  reference's per-worker scale);
 - each replica quantizes once before the reduce (client wire,
   кластер.py:474-496) — the integer partial sums then accumulate EXACTLY,
   unlike float wire formats;
@@ -32,9 +34,12 @@ Quantization semantics (mirroring the reference's two loss points):
   mean gradients — the reference's self-application guarantee
   (кластер.py:402-433) by construction.
 
-Total per-element error ≤ scale/levels (one half-step per quantization,
-two quantizations) — the same bound as the simulate path with
-``quantize_local=quantize_mean=True``.
+Total per-element error: with ``rounding='nearest'`` ≤ scale/levels (one
+half-step per quantization, two quantizations); with
+``rounding='stochastic'`` each quantization can miss by up to a FULL step
+(the draw is unbiased, not nearest), so the worst case is 2·scale/levels.
+Either way this matches the simulate path's bound for the same rounding
+mode with ``quantize_local=quantize_mean=True``.
 """
 
 from __future__ import annotations

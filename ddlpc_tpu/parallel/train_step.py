@@ -40,6 +40,22 @@ from ddlpc_tpu.parallel.grad_sync import sync_gradients
 PyTree = Any
 
 
+def _rounding_rng(
+    compression: CompressionConfig, seed: int, step: jax.Array
+) -> Optional[jax.Array]:
+    """Stochastic-rounding key: a pure function of (experiment seed,
+    replicated step counter), so every replica derives the same key
+    (bit-identical rounding decisions), resumed runs replay the same noise,
+    and different seeds draw different rounding noise (seed-sensitivity
+    studies need the noise to vary with the seed).  Shared by both step
+    builders so their key schedules cannot diverge."""
+    if compression.rounding != "stochastic":
+        return None
+    return jax.random.fold_in(
+        jax.random.fold_in(jax.random.key(0x5EED), seed), step
+    )
+
+
 class TrainState(struct.PyTreeNode):
     """Replicated training state.
 
@@ -151,6 +167,7 @@ def make_train_step(
     data_axis: str = "data",
     donate_state: bool = True,
     remat: bool = False,
+    seed: int = 0,
 ) -> Callable[[TrainState, jax.Array, jax.Array], Tuple[TrainState, dict]]:
     """Build the jitted SPMD train step.
 
@@ -183,14 +200,7 @@ def make_train_step(
             lambda x: lax.pmean(x, data_axis), batch_stats
         )
         # The one collective of the step — replaces reference L0–L4.
-        # Stochastic-rounding key: a pure function of the replicated step
-        # counter, so every replica derives the same key (bit-identical
-        # rounding decisions) and resumed runs replay the same noise.
-        rng = (
-            jax.random.fold_in(jax.random.key(0x5EED), state.step)
-            if compression.rounding == "stochastic"
-            else None
-        )
+        rng = _rounding_rng(compression, seed, state.step)
         grads = sync_gradients(
             grads,
             data_axis,
@@ -233,6 +243,7 @@ def make_train_step_gspmd(
     space_axis: Optional[str] = "space",
     donate_state: bool = True,
     remat: bool = False,
+    seed: int = 0,
 ) -> Callable[[TrainState, jax.Array, jax.Array], Tuple[TrainState, dict]]:
     """GSPMD train step: batch sharded over ``data`` AND H over ``space``.
 
@@ -268,6 +279,18 @@ def make_train_step_gspmd(
             "use the shard_map step (pure data mesh); the GSPMD partitioner "
             "owns the collectives in this path"
         )
+    if compression.mode != "none" and compression.quantize_local:
+        # Refuse rather than silently drop a configured loss point: a config
+        # recording quantize_local=True would claim codec semantics the
+        # executed program does not have.  The config artifact must match
+        # what runs.
+        raise ValueError(
+            "the GSPMD step cannot apply quantize_local (no per-replica "
+            "gradient exists in the program — only the averaged gradient is "
+            "representable): set compression.quantize_local=False to record "
+            "the semantics that actually execute, or use a pure data mesh "
+            "(shard_map step) for reference-parity two-point codec semantics"
+        )
 
     def step_fn(state: TrainState, images: jax.Array, labels: jax.Array):
         grads, batch_stats, losses, accs = _accumulate_grads(
@@ -276,11 +299,7 @@ def make_train_step_gspmd(
         if compression.mode != "none":
             from ddlpc_tpu.parallel.grad_sync import resolve_codec_backend
 
-            rng = (
-                jax.random.fold_in(jax.random.key(0x5EED), state.step)
-                if compression.rounding == "stochastic"
-                else None
-            )
+            rng = _rounding_rng(compression, seed, state.step)
             grads = resolve_codec_backend(compression)(
                 grads, compression, key=rng
             )

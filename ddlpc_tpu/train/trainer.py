@@ -160,6 +160,7 @@ class Trainer:
                 data_axis=cfg.parallel.data_axis_name,
                 space_axis=cfg.parallel.space_axis_name,
                 remat=cfg.train.remat,
+                seed=cfg.train.seed,
             )
         return make_train_step(
             self.model,
@@ -168,6 +169,7 @@ class Trainer:
             cfg.compression,
             data_axis=cfg.parallel.data_axis_name,
             remat=cfg.train.remat,
+            seed=cfg.train.seed,
         )
 
     def _restore_synchronized(self) -> None:

@@ -87,9 +87,14 @@ class StallWatchdog:
         try:
             yield
         finally:
+            # Beat before (and atomically with) the depth decrement: the
+            # monitor reads _pause_depth under this lock, so it can never
+            # observe depth==0 while _last is still stale by the whole
+            # paused duration (which would fire a spurious stall right
+            # after a long checkpoint/image dump).
             with self._pause_lock:
+                self.beat(f"after_{tag}")
                 self._pause_depth -= 1
-            self.beat(f"after_{tag}")
 
     # -- lifecycle ---------------------------------------------------------
 

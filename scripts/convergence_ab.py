@@ -88,7 +88,9 @@ def run_variant(
     h, w = image_size
     state = create_train_state(model, tx, jax.random.key(seed), (1, h, w, 3))
     state = jax.device_put(state, NamedSharding(mesh, P()))
-    step = make_train_step(model, tx, mesh, cfg.compression)
+    # seed= so rounding='stochastic' arms draw seed-dependent codec noise
+    # (the point of a seed sweep); the key stays resume-deterministic.
+    step = make_train_step(model, tx, mesh, cfg.compression, seed=seed)
     eval_step = make_eval_step(model, mesh, cfg.model.num_classes)
 
     train_ds, test_ds = train_test_split(

