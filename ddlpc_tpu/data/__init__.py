@@ -10,6 +10,7 @@ processes and mesh replicas, with a synthetic generator for tests/benchmarks.
 from ddlpc_tpu.data.datasets import (  # noqa: F401
     CropDataset,
     DihedralAugment,
+    HardTiles,
     SyntheticTiles,
     TileDataset,
     build_dataset,

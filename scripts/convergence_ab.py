@@ -38,9 +38,10 @@ from ddlpc_tpu.config import (
     ParallelConfig,
     TrainConfig,
 )
-from ddlpc_tpu.data import SyntheticTiles, train_test_split
+from ddlpc_tpu.data import train_test_split
+from ddlpc_tpu.data.datasets import SYNTHETIC_GENERATORS
 from ddlpc_tpu.models import build_model_from_experiment
-from ddlpc_tpu.ops.metrics import accuracy_from_confusion, mean_iou
+from ddlpc_tpu.ops.metrics import accuracy_from_confusion, iou_per_class, mean_iou
 from ddlpc_tpu.parallel.mesh import make_mesh
 from ddlpc_tpu.parallel.train_step import (
     create_train_state,
@@ -63,6 +64,9 @@ def run_variant(
     sync_period=4,
     seed=0,
     rounding: str = "nearest",
+    dataset: str = "synthetic",
+    head_dtype: str = "float32",
+    learning_rate: float = 1e-3,
 ) -> dict:
     cfg = ExperimentConfig(
         model=ModelConfig(
@@ -70,12 +74,13 @@ def run_variant(
             num_classes=6,
             stem="s2d" if stem_factor > 1 else "none",
             stem_factor=max(stem_factor, 2),
+            head_dtype=head_dtype,
         ),
         data=DataConfig(image_size=image_size),
         train=TrainConfig(
             micro_batch_size=micro_batch,
             sync_period=sync_period,
-            learning_rate=1e-3,
+            learning_rate=learning_rate,
             seed=seed,
         ),
         parallel=ParallelConfig(),
@@ -94,7 +99,7 @@ def run_variant(
     eval_step = make_eval_step(model, mesh, cfg.model.num_classes)
 
     train_ds, test_ds = train_test_split(
-        SyntheticTiles(num_tiles, image_size, seed=1), test_split
+        SYNTHETIC_GENERATORS[dataset](num_tiles, image_size, seed=1), test_split
     )
     repl = NamedSharding(mesh, P())
     # One upload; every batch is an on-device gather.
@@ -144,6 +149,11 @@ def run_variant(
         return {
             "val_miou": float(mean_iou(cm)),
             "val_pixel_acc": float(accuracy_from_confusion(cm)),
+            # Per-class IoU: on the hard task the arms differ on the rare
+            # sub-16-px classes (lines/discs/checker), not the bulk.
+            "val_iou_per_class": [
+                round(float(v), 4) for v in np.asarray(iou_per_class(cm))
+            ],
         }
 
     os.makedirs(outdir, exist_ok=True)
@@ -185,30 +195,67 @@ def main() -> None:
         help="comma list, e.g. nearest,stochastic — A/Bs the int8 codec's "
         "rounding rule at full 512² scale (docs/QUANTIZATION.md)",
     )
+    p.add_argument(
+        "--heads",
+        default="",
+        help="comma list of head dtypes, e.g. float32,bfloat16 — A/Bs the "
+        "bf16 logit-storage optimization's quality cost (docs/PERF.md)",
+    )
+    p.add_argument(
+        "--dataset",
+        default="synthetic",
+        choices=["synthetic", "synthetic_hard"],
+        help="synthetic_hard = the non-saturating task (sub-16-px structure, "
+        "class imbalance) whose converged mIoU stays < 1.0 so arms separate",
+    )
+    p.add_argument("--stems-none", action="store_true",
+                   help="include a stem-free (reference-layout) arm in --stems")
     args = p.parse_args()
+    ds = args.dataset
+    # Tag suffix keeps hard-task rows distinct from the legacy saturating
+    # rows inside the same summary.json.
+    sfx = "_hard" if ds == "synthetic_hard" else ""
 
     results = []
-    for sf in [int(s) for s in args.stems.split(",") if s]:
+    stems = [int(s) for s in args.stems.split(",") if s]
+    if args.stems_none:
+        stems = [1] + stems
+    for sf in stems:
         results.append(
             run_variant(
-                f"stem{sf}_fp16", sf, "float16", args.epochs, args.outdir
+                f"stem{sf}_fp16{sfx}", sf, "float16", args.epochs,
+                args.outdir, dataset=ds,
             )
         )
         print(json.dumps(results[-1]))
     for mode in [m for m in args.modes.split(",") if m]:
         results.append(
             run_variant(
-                f"mode_{mode}_stem{args.stem_for_modes}",
+                f"mode_{mode}_stem{args.stem_for_modes}{sfx}",
                 args.stem_for_modes,
                 mode,
                 args.epochs,
                 args.outdir,
+                dataset=ds,
+            )
+        )
+        print(json.dumps(results[-1]))
+    for head in [h for h in args.heads.split(",") if h]:
+        results.append(
+            run_variant(
+                f"head_{head}_stem{args.stem_for_modes}{sfx}",
+                args.stem_for_modes,
+                "none",
+                args.epochs,
+                args.outdir,
+                dataset=ds,
+                head_dtype=head,
             )
         )
         print(json.dumps(results[-1]))
     for rounding in [r for r in args.roundings.split(",") if r]:
-        tag = f"int8_{rounding}_stem{args.stem_for_modes}"
-        src_tag = f"mode_int8_stem{args.stem_for_modes}"
+        tag = f"int8_{rounding}_stem{args.stem_for_modes}{sfx}"
+        src_tag = f"mode_int8_stem{args.stem_for_modes}{sfx}"
         src = next((r for r in results if r["tag"] == src_tag), None)
         if rounding == "nearest" and src is not None:
             # int8+nearest IS the --modes int8 variant (nearest is the
@@ -230,6 +277,7 @@ def main() -> None:
                 args.epochs,
                 args.outdir,
                 rounding=rounding,
+                dataset=ds,
             )
         results.append(rec)
         print(json.dumps(results[-1]))

