@@ -79,6 +79,40 @@ def wire_dtype(axis_size: int, levels: int) -> jnp.dtype:
     )
 
 
+def ring_wire_report(num_elements: int, axis_size: int, cfg: CompressionConfig) -> dict:
+    """Exact wire-byte accounting for one ring all-reduce vs the fp32 ring.
+
+    The reference logs its compressed payload sizes every sync
+    (кластер.py:47-52,116); this is the framework's equivalent evidence that
+    the compressed transport actually moves fewer interconnect bytes — the
+    numbers are computed from the algorithm (dtype × chunk × hops), not
+    asserted.  Per replica: 2(N-1) hops (reduce-scatter + all-gather), each
+    carrying one ceil(n/N)-element chunk in the wire dtype; the fp32
+    baseline is the same ring algorithm at 4 bytes/element (bandwidth-
+    optimal all-reduce moves ~2n bytes/replica regardless of topology, so
+    the ratio holds against any fp32 collective, not just a ring).
+    """
+    from ddlpc_tpu.ops.quantize import levels_for
+
+    if cfg.mode == "none":
+        wdt, itemsize = jnp.float32, 4  # exact pmean fallback: fp32 wire
+    else:
+        wdt = wire_dtype(axis_size, int(levels_for(cfg)))
+        itemsize = jnp.dtype(wdt).itemsize
+    chunk = -(-num_elements // axis_size)
+    hops = 2 * (axis_size - 1)
+    return {
+        "elements": num_elements,
+        "axis_size": axis_size,
+        "wire_dtype": str(jnp.dtype(wdt)),
+        "hops_per_replica": hops,
+        "bytes_per_hop": chunk * itemsize,
+        "wire_bytes_per_replica": hops * chunk * itemsize,
+        "fp32_bytes_per_replica": hops * chunk * 4,
+        "compression_ratio": 4.0 / itemsize,
+    }
+
+
 def _flatten(tree: PyTree) -> Tuple[jax.Array, List[Any], Any]:
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     shapes = [l.shape for l in leaves]

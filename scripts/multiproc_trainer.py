@@ -1,0 +1,185 @@
+"""The REAL multi-process data path, end to end (VERDICT r2 next #4).
+
+`multiproc_smoke.py` proves the bootstrap + compiled SPMD step across two
+OS processes, but it builds batches with `jax.make_array_from_callback`,
+bypassing the production loader.  This script drives the actual `Trainer`
+across 2 processes — the one code path that would feed a multi-host pod:
+
+- `ShardedLoader._local_batches` per-process slicing (loader.py) with
+  `jax.process_index() > 0` actually taken: a recording dataset wrapper
+  captures the tile indices each process gathers, and the ranks allgather
+  them to assert the shards are DISJOINT and cover the epoch permutation —
+  the property whose absence makes the reference do k× redundant work
+  (its shuffle is computed then never applied, кластер.py:722-723,750);
+- sharded evaluation through `eval_batches`' per-process slice;
+- checkpoint save (process 0 writes) + `Trainer(resume=True)` through
+  `_restore_synchronized`'s REAL `broadcast_one_to_all` path (no
+  monkeypatched process counts) — post-resume state must be bit-identical
+  across processes and to the pre-save state, and the epoch count must
+  continue.
+
+Usage: python scripts/multiproc_trainer.py   (parent; spawns both ranks)
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+
+def child(rank: int, port: int, workdir: str) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)  # 2 local -> 4 global devices
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from ddlpc_tpu.parallel.mesh import initialize_distributed
+
+    initialize_distributed(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=rank
+    )
+    assert jax.process_count() == 2
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    from ddlpc_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        ModelConfig,
+        ParallelConfig,
+        TrainConfig,
+    )
+    from ddlpc_tpu.data.datasets import TileDataset
+    from ddlpc_tpu.train.trainer import Trainer
+
+    cfg = ExperimentConfig(
+        model=ModelConfig(
+            features=(8,), bottleneck_features=8, num_classes=3, norm="group"
+        ),
+        data=DataConfig(
+            dataset="synthetic",
+            image_size=(32, 32),
+            synthetic_len=24,
+            test_split=8,
+            num_classes=3,
+        ),
+        train=TrainConfig(
+            epochs=2,
+            micro_batch_size=2,  # global micro 8 over the 4-device data axis
+            sync_period=2,
+            dump_images_per_epoch=0,
+            checkpoint_every_epochs=1,
+            eval_every_epochs=1,
+        ),
+        parallel=ParallelConfig(data_axis_size=4),
+        workdir=workdir,
+    )
+
+    class RecordingDataset(TileDataset):
+        """Records every index this process's loader actually gathers."""
+
+        def __init__(self, base: TileDataset):
+            super().__init__(base.images, base.labels)
+            self.seen: list = []
+
+        def gather(self, indices):
+            self.seen.append(np.asarray(indices).copy())
+            return super().gather(indices)
+
+    trainer = Trainer(cfg, resume=False)
+    rec = RecordingDataset(trainer.loader.ds)
+    trainer.loader.ds = rec
+    final = trainer.fit()
+    assert "val_miou" in final, final  # sharded eval ran
+
+    # --- per-process shards are disjoint per super-batch -----------------
+    # Each gather call is one super-batch's local slice; comparing the two
+    # ranks' slices of the SAME super-batch must show no overlap (within an
+    # epoch processes must never duplicate work) and their union must be the
+    # full global super-batch.
+    seen = np.stack(rec.seen)  # [num_super_batches_total, A*B_local]
+    g = multihost_utils.process_allgather(seen)  # [2, n, A*B_local]
+    sb = trainer.loader.super_batch
+    for t in range(seen.shape[0]):
+        s0, s1 = set(g[0][t].tolist()), set(g[1][t].tolist())
+        assert not (s0 & s1), f"super-batch {t}: ranks gathered overlapping tiles"
+        assert len(s0 | s1) == min(sb, len(trainer.train_ds)), (
+            f"super-batch {t}: union {len(s0 | s1)} != global super-batch"
+        )
+    assert set(np.unique(seen)) <= set(range(len(trainer.train_ds)))
+
+    # --- replicated state agrees across processes ------------------------
+    def digest(state):
+        flat = jnp.concatenate(
+            [jnp.ravel(l) for l in jax.tree.leaves(state.params)]
+        )
+        return np.asarray(flat.addressable_data(0))
+
+    d_final = digest(trainer.state)
+    g = multihost_utils.process_allgather(d_final)
+    assert np.array_equal(g[0], g[1]), "post-training params diverged"
+
+    # --- restart: REAL synchronized resume -------------------------------
+    resumed = Trainer(cfg, resume=True)
+    assert resumed.start_epoch == 2, resumed.start_epoch
+    d_resumed = digest(resumed.state)
+    assert np.array_equal(d_resumed, d_final), (
+        "resumed state != saved state (rank %d)" % rank
+    )
+    g2 = multihost_utils.process_allgather(d_resumed)
+    assert np.array_equal(g2[0], g2[1]), "resumed params diverged across ranks"
+
+    print(f"[rank {rank}] trainer-e2e OK (epochs resumed at {resumed.start_epoch})",
+          flush=True)
+
+
+def main() -> int:
+    import socket
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    workdir = tempfile.mkdtemp(prefix="mp_trainer_")
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                os.path.abspath(__file__),
+                "--rank",
+                str(r),
+                str(port),
+                workdir,
+            ]
+        )
+        for r in range(2)
+    ]
+    deadline = time.monotonic() + 480
+    try:
+        rcs = [p.wait(timeout=max(deadline - time.monotonic(), 1.0)) for p in procs]
+    except subprocess.TimeoutExpired:
+        print("FAILED: rank hung", file=sys.stderr)
+        return 1
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    if any(rcs):
+        print(f"FAILED: exit codes {rcs}", file=sys.stderr)
+        return 1
+    print("multiproc trainer OK")
+    return 0
+
+
+if __name__ == "__main__":
+    if "--rank" in sys.argv:
+        i = sys.argv.index("--rank")
+        child(int(sys.argv[i + 1]), int(sys.argv[i + 2]), sys.argv[i + 3])
+    else:
+        sys.exit(main())

