@@ -38,6 +38,12 @@ class ModelConfig:
     # (models/layers.py:space_to_depth).
     stem: str = "none"  # none | s2d
     stem_factor: int = 2
+    # Full-resolution residual refinement after the subpixel head
+    # (models/layers.py:DetailHead): two cheap full-res convs over
+    # concat(logits, raw image) restore sub-stem_factor-px structure the
+    # 1/r pyramid cannot carry.  Measured on the HardTiles stem A/B, where
+    # plain s2d collapses the 2-6 px disc class.
+    detail_head: bool = False
     # Deep supervision heads for U-Net++.
     deep_supervision: bool = False
     # DeepLabV3+ specifics.

@@ -17,6 +17,12 @@ from ddlpc_tpu.models.unet import UNet
 from ddlpc_tpu.models.unetpp import UNetPP
 
 _REGISTRY = {}
+# Models that implement ModelConfig.detail_head.  Checked centrally in
+# build_model so a newly registered model is safe by default: a config
+# artifact must never claim a refinement head the built network lacks
+# (same principle as the GSPMD quantize_local rejection,
+# parallel/train_step.py).
+_DETAIL_HEAD_MODELS = {"unet"}
 
 
 def register(name: str):
@@ -42,6 +48,7 @@ def _build_unet(cfg: ModelConfig, norm_axis_name: Optional[str]) -> nn.Module:
         norm_groups=cfg.group_norm_groups,
         stem=cfg.stem,
         stem_factor=cfg.stem_factor,
+        detail_head=cfg.detail_head,
         dtype=jnp.dtype(cfg.compute_dtype),
         head_dtype=jnp.dtype(cfg.head_dtype),
     )
@@ -93,6 +100,12 @@ def build_model(cfg: ModelConfig, norm_axis_name: Optional[str] = None) -> nn.Mo
         raise ValueError(
             f"unknown model {cfg.name!r}; registered: {sorted(_REGISTRY)}"
         ) from None
+    if cfg.detail_head and cfg.name not in _DETAIL_HEAD_MODELS:
+        raise ValueError(
+            f"model {cfg.name!r} does not implement detail_head "
+            f"(supported: {sorted(_DETAIL_HEAD_MODELS)}) — set "
+            f"model.detail_head=False"
+        )
     return builder(cfg, norm_axis_name)
 
 

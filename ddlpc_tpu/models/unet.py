@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ddlpc_tpu.models.layers import (
+    DetailHead,
     DoubleConv,
     DownBlock,
     UpBlock,
@@ -38,6 +39,9 @@ class UNet(nn.Module):
     norm_groups: int = 8
     stem: str = "none"  # none | s2d (see ModelConfig.stem)
     stem_factor: int = 2
+    # Full-resolution residual refinement after the subpixel head — restores
+    # sub-stem_factor-px structure the 1/r pyramid cannot carry (DetailHead).
+    detail_head: bool = False
     dtype: Any = jnp.bfloat16
     head_dtype: Any = jnp.float32  # see ModelConfig.head_dtype
 
@@ -50,6 +54,7 @@ class UNet(nn.Module):
         2**len(features) (× ``stem_factor`` with the s2d stem); returns
         logits [N, H, W, num_classes] in ``head_dtype`` (float32 default)."""
         x = x.astype(self.dtype)
+        image = x  # raw full-res input, kept for the optional DetailHead
         # s2d: run the whole pyramid at 1/r resolution on r²-richer
         # channels; logits return to full resolution via a subpixel head.
         x = apply_stem(x, self.stem, self.stem_factor)
@@ -74,4 +79,11 @@ class UNet(nn.Module):
             dtype=self.head_dtype,
             param_dtype=jnp.float32,
         )(x.astype(self.head_dtype))
-        return restore_head(logits, self.stem, self.stem_factor)
+        logits = restore_head(logits, self.stem, self.stem_factor)
+        if self.detail_head:
+            logits = DetailHead(
+                self.num_classes,
+                dtype=self.dtype,
+                head_dtype=self.head_dtype,
+            )(logits, image)
+        return logits

@@ -247,8 +247,17 @@ class Trainer:
             # Mean time per sync step — the reference's "среднее время на
             # батч" line (кластер.py:767-770).
             "step_time_s": epoch_time / steps,
+            # Compute throughput: tile-instances processed (wrap-fill
+            # duplicates included — they are real forward/backward work).
             "tiles_per_s": len(self.loader) * self.loader.super_batch / epoch_time,
         }
+        # When the super-batch exceeds the dataset, an "epoch" processes each
+        # tile wrap_factor times — record it so tiles_per_s cannot read as
+        # dataset coverage (VERDICT r2: flagship super-batch 2048 vs 97 tiles
+        # counts each tile ~21x per epoch).
+        wrap = len(self.loader) * self.loader.super_batch / max(len(self.train_ds), 1)
+        if wrap > 1.0 + 1e-9:
+            record["wrap_fill_factor"] = round(wrap, 2)
         record.update(
             {f"t_{name}_s": t for name, t in self.timer.means().items()}
         )

@@ -67,6 +67,7 @@ def run_variant(
     dataset: str = "synthetic",
     head_dtype: str = "float32",
     learning_rate: float = 1e-3,
+    detail_head: bool = False,
 ) -> dict:
     cfg = ExperimentConfig(
         model=ModelConfig(
@@ -75,6 +76,7 @@ def run_variant(
             stem="s2d" if stem_factor > 1 else "none",
             stem_factor=max(stem_factor, 2),
             head_dtype=head_dtype,
+            detail_head=detail_head,
         ),
         data=DataConfig(image_size=image_size),
         train=TrainConfig(
@@ -210,6 +212,13 @@ def main() -> None:
     )
     p.add_argument("--stems-none", action="store_true",
                    help="include a stem-free (reference-layout) arm in --stems")
+    p.add_argument(
+        "--details",
+        default="",
+        help="comma list of stem factors to run WITH the full-res DetailHead "
+        "(models/layers.py) — the refinement that restores sub-stem-px "
+        "structure; tags get a _detail suffix",
+    )
     args = p.parse_args()
     ds = args.dataset
     # Tag suffix keeps hard-task rows distinct from the legacy saturating
@@ -225,6 +234,14 @@ def main() -> None:
             run_variant(
                 f"stem{sf}_fp16{sfx}", sf, "float16", args.epochs,
                 args.outdir, dataset=ds,
+            )
+        )
+        print(json.dumps(results[-1]))
+    for sf in [int(s) for s in args.details.split(",") if s]:
+        results.append(
+            run_variant(
+                f"stem{sf}_detail_fp16{sfx}", sf, "float16", args.epochs,
+                args.outdir, dataset=ds, detail_head=True,
             )
         )
         print(json.dumps(results[-1]))

@@ -323,3 +323,38 @@ def test_build_model_from_experiment_wires_sync_bn():
     assert build_model_from_experiment(e).norm_axis_name == "data"
     e2 = e.replace(parallel=ParallelConfig(sync_batch_norm=False))
     assert build_model_from_experiment(e2).norm_axis_name is None
+
+
+def test_unet_detail_head_learns(tmp_path):
+    """detail_head=True (full-res residual refinement over the subpixel
+    head, models/layers.py:DetailHead) must train end to end — it exists to
+    restore sub-stem_factor-px structure the 1/r pyramid cannot carry
+    (HardTiles stem A/B: the 2-6 px disc class collapses without it)."""
+    from ddlpc_tpu.config import DataConfig, ExperimentConfig, TrainConfig
+    from ddlpc_tpu.train.trainer import Trainer
+
+    cfg = ExperimentConfig(
+        model=ModelConfig(
+            features=(8, 16), bottleneck_features=16, num_classes=4,
+            stem="s2d", stem_factor=4, detail_head=True,
+            head_dtype="bfloat16",
+        ),
+        data=DataConfig(dataset="synthetic", image_size=(64, 64),
+                        synthetic_len=40, test_split=8, num_classes=4),
+        train=TrainConfig(epochs=25, micro_batch_size=1, sync_period=2,
+                          learning_rate=3e-3, dump_images_per_epoch=0,
+                          checkpoint_every_epochs=0),
+        workdir=str(tmp_path),
+    )
+    rec = Trainer(cfg).fit()
+    assert rec["val_miou"] > 0.5
+
+
+@pytest.mark.parametrize("name", ["unetpp", "deeplabv3p"])
+def test_detail_head_rejected_outside_unet(name):
+    """A config artifact must not claim a refinement head the built model
+    does not have (same principle as the GSPMD quantize_local rejection)."""
+    from ddlpc_tpu.models import build_model
+
+    with pytest.raises(ValueError, match="detail_head"):
+        build_model(ModelConfig(name=name, detail_head=True))
