@@ -224,8 +224,14 @@ def run_bench(name: str, timed_rounds: int = TIMED_ROUNDS) -> dict:
     compiled = step.lower(state, images, labels).compile()
     try:
         # cost_analysis() reports the post-partitioning (per-device) module,
-        # so this is already per-chip FLOPs — no further /n_devices.
-        flops = compiled.cost_analysis()["flops"]
+        # so this is already per-chip FLOPs — no further /n_devices.  BUT it
+        # counts a while/scan body ONCE regardless of trip count (verified:
+        # lowering with sync_period 1 vs 4 reports identical flops), so the
+        # A-micro-batch accumulation scan must be re-multiplied — without
+        # this every MFU reported here is ~A× understated (the round-2
+        # tables were).  The small non-scan epilogue (codec + Adam) gets
+        # over-multiplied by the same factor; it is <1% of step FLOPs.
+        flops = compiled.cost_analysis()["flops"] * A
     except Exception:
         flops = float("nan")
 

@@ -234,7 +234,11 @@ class Trainer:
             losses.append(metrics["loss"])
             accs.append(metrics["pixel_acc"])
         # One host sync per epoch (metrics stayed on device inside the loop).
+        # Single batched device_get: per-element float() would cost one full
+        # host round trip PER STEP on tunneled/remote devices (~115 ms each,
+        # docs/PERF.md) — at flagship step times that is ~30% of the epoch.
         self.watchdog.beat("epoch_metrics_fetch")
+        losses, accs = jax.device_get((losses, accs))
         losses = [float(l) for l in losses]
         accs = [float(a) for a in accs]
         epoch_time = time.perf_counter() - t_epoch
