@@ -22,7 +22,7 @@ _REGISTRY = {}
 # artifact must never claim a refinement head the built network lacks
 # (same principle as the GSPMD quantize_local rejection,
 # parallel/train_step.py).
-_DETAIL_HEAD_MODELS = {"unet"}
+_DETAIL_HEAD_MODELS = {"unet", "unetpp"}
 
 
 def register(name: str):
@@ -69,6 +69,7 @@ def _build_unetpp(cfg: ModelConfig, norm_axis_name: Optional[str]) -> nn.Module:
         deep_supervision=cfg.deep_supervision,
         stem=cfg.stem,
         stem_factor=cfg.stem_factor,
+        detail_head=cfg.detail_head,
         dtype=jnp.dtype(cfg.compute_dtype),
         head_dtype=jnp.dtype(cfg.head_dtype),
     )

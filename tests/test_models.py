@@ -350,11 +350,35 @@ def test_unet_detail_head_learns(tmp_path):
     assert rec["val_miou"] > 0.5
 
 
-@pytest.mark.parametrize("name", ["unetpp", "deeplabv3p"])
-def test_detail_head_rejected_outside_unet(name):
+def test_detail_head_rejected_where_unimplemented():
     """A config artifact must not claim a refinement head the built model
-    does not have (same principle as the GSPMD quantize_local rejection)."""
+    does not have (same principle as the GSPMD quantize_local rejection).
+    U-Net and U-Net++ implement it; DeepLab does not."""
     from ddlpc_tpu.models import build_model
 
     with pytest.raises(ValueError, match="detail_head"):
-        build_model(ModelConfig(name=name, detail_head=True))
+        build_model(ModelConfig(name="deeplabv3p", detail_head=True))
+
+
+def test_unetpp_detail_head_learns(tmp_path):
+    """U-Net++ shares ONE DetailHead across all supervision heads (shared
+    params keep the heads consistent); it must train end to end with deep
+    supervision and produce full-res refined logits at inference."""
+    from ddlpc_tpu.config import DataConfig, ExperimentConfig, TrainConfig
+    from ddlpc_tpu.train.trainer import Trainer
+
+    cfg = ExperimentConfig(
+        model=ModelConfig(
+            name="unetpp", features=(8, 16, 32), num_classes=4,
+            deep_supervision=True, stem="s2d", stem_factor=2,
+            detail_head=True, head_dtype="bfloat16",
+        ),
+        data=DataConfig(dataset="synthetic", image_size=(64, 64),
+                        synthetic_len=40, test_split=8, num_classes=4),
+        train=TrainConfig(epochs=25, micro_batch_size=1, sync_period=2,
+                          learning_rate=3e-3, dump_images_per_epoch=0,
+                          checkpoint_every_epochs=0),
+        workdir=str(tmp_path),
+    )
+    rec = Trainer(cfg).fit()
+    assert rec["val_miou"] > 0.5
