@@ -103,8 +103,9 @@ BENCHES = {
         compression="float16",
     ),
     # Quality-first zoo row (docs/HARD_TASK.md): s2d×2 + DetailHead
-    # converges to 0.956 on the hard task (vs full-res 0.968, flagship
-    # 0.897) at 1.6× the 400 target.  Sweep: B=64→484, 96→643.
+    # converges to 0.956 on the hard task (vs full-res 0.991 at the same
+    # 120-epoch budget; flagship 0.897) at 1.6× the 400 target.
+    # Sweep: B=64→484, 96→643.
     "unet_vaihingen512_s2d2_detail": dict(
         model=dict(
             width_divisor=2,

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import os
 import time
+import warnings
 from typing import Dict, Optional
 
 import jax
@@ -77,6 +78,31 @@ class Trainer:
         self.mesh = make_mesh(cfg.parallel)
         data_size = self.mesh.shape[cfg.parallel.data_axis_name]
         self.global_micro_batch = cfg.train.micro_batch_size * data_size
+        # Stochastic rounding's benefit is regime-dependent (measured, not
+        # assumed — docs/QUANTIZATION.md round-3 table): at global super-batch
+        # 32 it closes the int8 codec's entire convergence lag, but at the
+        # flagship's 512 it COSTS −0.045 val mIoU vs nearest rounding (the
+        # big batch already averages the rounding error away, so the injected
+        # variance is pure noise).  Warn anyone combining it with a
+        # large-batch operating point.
+        global_super_batch = self.global_micro_batch * cfg.train.sync_period
+        if (
+            cfg.compression.mode != "none"
+            and cfg.compression.rounding == "stochastic"
+            and global_super_batch >= 256
+        ):
+            warnings.warn(
+                f"rounding='stochastic' at global super-batch "
+                f"{global_super_batch} (micro {cfg.train.micro_batch_size} x "
+                f"sync {cfg.train.sync_period} x {data_size} replicas): the "
+                f"committed A/B measured stochastic rounding HELPING at small "
+                f"batch (closes int8's lag at super-batch 32) but COSTING "
+                f"-0.045 val mIoU at super-batch 512 "
+                f"(docs/QUANTIZATION.md round-3 table) — large batches "
+                f"average quantization error away on their own; prefer "
+                f"rounding='nearest' here",
+                stacklevel=2,
+            )
 
         self.train_ds, self.test_ds = build_dataset(cfg.data)
         self.model = build_model_from_experiment(cfg)
@@ -237,16 +263,25 @@ class Trainer:
         # Single batched device_get: per-element float() would cost one full
         # host round trip PER STEP on tunneled/remote devices (~115 ms each,
         # docs/PERF.md) — at flagship step times that is ~30% of the epoch.
+        if not losses:
+            # A zero-step epoch (empty dataset / loader) would otherwise
+            # record NaN metrics and a meaningless step_time — fail loudly
+            # with the cause instead (ADVICE r3).
+            raise RuntimeError(
+                f"epoch {epoch} produced 0 training steps: dataset has "
+                f"{len(self.train_ds)} tiles against super-batch "
+                f"{self.loader.super_batch} — the loader yielded no batches"
+            )
         self.watchdog.beat("epoch_metrics_fetch")
         losses, accs = jax.device_get((losses, accs))
         losses = [float(l) for l in losses]
         accs = [float(a) for a in accs]
         epoch_time = time.perf_counter() - t_epoch
-        steps = max(len(losses), 1)
+        steps = len(losses)
         record = {
             "epoch": epoch,
-            "loss": float(np.mean(losses)) if losses else float("nan"),
-            "pixel_acc": float(np.mean(accs)) if accs else float("nan"),
+            "loss": float(np.mean(losses)),
+            "pixel_acc": float(np.mean(accs)),
             "epoch_time_s": epoch_time,
             # Mean time per sync step — the reference's "среднее время на
             # батч" line (кластер.py:767-770).

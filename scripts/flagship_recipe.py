@@ -30,7 +30,12 @@ import json
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
+# Repo root for ddlpc_tpu, scripts dir for convergence_ab: direct invocation
+# gets the latter for free via sys.path[0], but `python -m` / imports from
+# elsewhere do not (ADVICE r3).
+sys.path.insert(0, os.path.dirname(_SCRIPTS_DIR))
+sys.path.insert(0, _SCRIPTS_DIR)
 
 from convergence_ab import run_variant  # noqa: E402  (same directory)
 

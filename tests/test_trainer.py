@@ -274,3 +274,36 @@ def test_cli_overrides(tmp_path):
     assert resume is False
     with pytest.raises(KeyError):
         parse_config(["--set", "train.nope=1"])
+
+
+def test_stochastic_rounding_large_batch_warns(tmp_path):
+    """docs/QUANTIZATION.md round-3 table: stochastic rounding helps at
+    global super-batch 32 but costs -0.045 val mIoU at 512 — the Trainer
+    must warn when the codec's stochastic rounding meets a large-batch
+    operating point, and stay silent in the regime where it helps."""
+    import dataclasses
+
+    from ddlpc_tpu.config import CompressionConfig
+
+    def build(micro, sync, rounding):
+        cfg = tiny_config(str(tmp_path / f"w{micro}x{sync}{rounding}"))
+        cfg = cfg.replace(
+            train=dataclasses.replace(
+                cfg.train, micro_batch_size=micro, sync_period=sync
+            ),
+            compression=CompressionConfig(mode="int8", rounding=rounding),
+        )
+        return Trainer(cfg, resume=False)
+
+    n_dev = jax.device_count()
+    with pytest.warns(UserWarning, match="super-batch"):
+        build(-(-256 // (4 * n_dev)), 4, "stochastic")  # >= 256 global
+    import warnings as _warnings
+
+    if 2 * n_dev < 256:  # on a pod-sized host even micro=1 is large-batch
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")  # any codec warning fails
+            build(1, 2, "stochastic")  # super-batch 2*n_dev: helping regime
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        build(-(-256 // (4 * n_dev)), 4, "nearest")  # large but deterministic
