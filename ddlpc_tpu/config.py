@@ -44,6 +44,43 @@ class ModelConfig:
     # 1/r pyramid cannot carry.  Measured on the HardTiles stem A/B, where
     # plain s2d collapses the 2-6 px disc class.
     detail_head: bool = False
+    # Which refinement architecture detail_head selects:
+    # - 'fullres': two 3×3 convs at FULL resolution over concat(d2s logits,
+    #   raw image) — pixel-translation-equivariant, but its low-channel
+    #   full-res convs run lane-padded at 9-37 TF/s and its weight-gradient
+    #   contractions over [B,H·W] dominated the round-3 step (docs/PERF.md
+    #   roofline: ~43% of the flagship step in the head region);
+    # - 's2d': the same residual refinement computed AT THE STEM GRID on the
+    #   pre-d2s logits concat s2d(image) — channels (classes·r² + 3·r²) land
+    #   in the MXU-efficient regime, weights are per-subpixel-phase (cell-
+    #   level equivariance instead of pixel-level; strictly more parameters
+    #   per FLOP), and no full-resolution activation exists in the head.
+    detail_head_kind: str = "fullres"  # fullres | s2d
+    # Hidden width of the refinement convs (round-3 shipped the only point
+    # ever trained, 16; VERDICT r3 demanded the capacity sweep).
+    detail_head_hidden: int = 16
+    # Layout of the logits the model returns under train=True with an s2d
+    # stem:
+    # - 'fullres': depth_to_space to [B,H,W,classes] before the loss
+    #   (round-3 behavior) — costs the d2s layout transpose plus loss/metric
+    #   reductions over a 512² tensor whose last dim (classes) lane-pads
+    #   ~20× on TPU;
+    # - 'grouped': return the pre-d2s phase-major logits [B,H/r,W/r,r²·C];
+    #   the train step groups the labels identically and computes the SAME
+    #   per-pixel loss/metrics on the [..., r², C] view — bit-equal math
+    #   (same multiset of (logit-row, label) pairs), no full-res tensor
+    #   anywhere in the train graph.  Eval/predict always return full-res
+    #   logits regardless.
+    train_head_layout: str = "fullres"  # fullres | grouped
+    # U-Net++ only: which logits the (shared) refinement head runs on.
+    # - 'per_head': refine every deep-supervision head's logits (round-3
+    #   behavior) — the refinement COMPUTE runs once per head, measured
+    #   −43% throughput on the s2d×4 zoo row (678 → 383 tiles/s/chip);
+    # - 'ensemble': supervision heads train unrefined; ONE refinement pass
+    #   runs on the ensemble-mean readout, which joins the deep-supervision
+    #   loss as an extra supervised output and is exactly the logits
+    #   inference returns.  Refinement cost ×1 instead of ×(depth-1).
+    detail_head_scope: str = "per_head"  # per_head | ensemble
     # Deep supervision heads for U-Net++.
     deep_supervision: bool = False
     # DeepLabV3+ specifics.

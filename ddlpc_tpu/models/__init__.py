@@ -49,6 +49,9 @@ def _build_unet(cfg: ModelConfig, norm_axis_name: Optional[str]) -> nn.Module:
         stem=cfg.stem,
         stem_factor=cfg.stem_factor,
         detail_head=cfg.detail_head,
+        detail_head_kind=cfg.detail_head_kind,
+        detail_head_hidden=cfg.detail_head_hidden,
+        train_head_layout=cfg.train_head_layout,
         dtype=jnp.dtype(cfg.compute_dtype),
         head_dtype=jnp.dtype(cfg.head_dtype),
     )
@@ -70,6 +73,10 @@ def _build_unetpp(cfg: ModelConfig, norm_axis_name: Optional[str]) -> nn.Module:
         stem=cfg.stem,
         stem_factor=cfg.stem_factor,
         detail_head=cfg.detail_head,
+        detail_head_kind=cfg.detail_head_kind,
+        detail_head_hidden=cfg.detail_head_hidden,
+        detail_head_scope=cfg.detail_head_scope,
+        train_head_layout=cfg.train_head_layout,
         dtype=jnp.dtype(cfg.compute_dtype),
         head_dtype=jnp.dtype(cfg.head_dtype),
     )
@@ -107,6 +114,49 @@ def build_model(cfg: ModelConfig, norm_axis_name: Optional[str] = None) -> nn.Mo
             f"(supported: {sorted(_DETAIL_HEAD_MODELS)}) — set "
             f"model.detail_head=False"
         )
+    # The layout/kind combinations are validated HERE, not silently ignored
+    # in the model: a config artifact claiming a layout the built network
+    # would not execute is a lie in the artifact (same principle as the
+    # GSPMD quantize_local rejection, parallel/train_step.py).
+    if cfg.detail_head_kind not in ("fullres", "s2d"):
+        raise ValueError(
+            f"unknown detail_head_kind {cfg.detail_head_kind!r} "
+            f"(fullres | s2d)"
+        )
+    if cfg.train_head_layout not in ("fullres", "grouped"):
+        raise ValueError(
+            f"unknown train_head_layout {cfg.train_head_layout!r} "
+            f"(fullres | grouped)"
+        )
+    if cfg.detail_head_scope not in ("per_head", "ensemble"):
+        raise ValueError(
+            f"unknown detail_head_scope {cfg.detail_head_scope!r} "
+            f"(per_head | ensemble)"
+        )
+    if cfg.detail_head and cfg.detail_head_kind == "s2d" and cfg.stem != "s2d":
+        raise ValueError(
+            "detail_head_kind='s2d' refines the pre-d2s logit grid and "
+            "requires stem='s2d'; with stem='none' use "
+            "detail_head_kind='fullres'"
+        )
+    if cfg.train_head_layout == "grouped":
+        if cfg.stem != "s2d":
+            raise ValueError(
+                "train_head_layout='grouped' skips the subpixel d2s in the "
+                "train path — it requires stem='s2d'"
+            )
+        if cfg.detail_head and cfg.detail_head_kind == "fullres":
+            raise ValueError(
+                "train_head_layout='grouped' cannot feed a full-resolution "
+                "DetailHead (it needs full-res logits): use "
+                "detail_head_kind='s2d' or train_head_layout='fullres'"
+            )
+        if cfg.name not in _DETAIL_HEAD_MODELS:
+            raise ValueError(
+                f"model {cfg.name!r} does not implement "
+                f"train_head_layout='grouped' (supported: "
+                f"{sorted(_DETAIL_HEAD_MODELS)})"
+            )
     return builder(cfg, norm_axis_name)
 
 

@@ -226,6 +226,72 @@ class DetailHead(nn.Module):
         return logits + delta
 
 
+class StemGridDetailHead(nn.Module):
+    """Residual refinement computed AT THE STEM GRID (detail_head_kind='s2d').
+
+    The full-resolution DetailHead above buys its quality with the worst-
+    shaped convs in the net: C=9→16 at 512² runs lane-padded at 9-37 TF/s
+    and its weight gradients contract over [B, H·W] — measured ~43% of the
+    round-3 flagship step (docs/PERF.md roofline).  This variant computes
+    the SAME residual-correction idea without ever leaving the stem grid:
+
+        z += Conv3x3(C·r²) . relu . Conv3x3(hidden) (z ++ s2d(image))
+
+    where z is the pre-depth_to_space logit tensor [B, H/r, W/r, C·r²] and
+    s2d(image) packs every raw pixel losslessly into 3·r² channels — the
+    head sees exactly the information the full-res head sees.  What changes
+    is the equivariance group: weights are shared across stem CELLS, not
+    pixels, so each of the r² subpixel phases gets its own filters (more
+    parameters per FLOP, cell-level instead of pixel-level translation
+    equivariance).  A 3×3 conv here spans 3r×3r raw pixels of context vs
+    the full-res head's 3×3.  Every conv lands in the MXU-efficient
+    channel regime (C≥96 for the flagship's r=4).
+
+    Quality is an empirical question per task — measured on the HardTiles
+    sweep (docs/HARD_TASK.md round-4 table) rather than assumed.
+    """
+
+    num_classes: int
+    stem_factor: int
+    hidden: int = 64
+    dtype: Dtype = jnp.bfloat16
+    head_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, z: jax.Array, image: jax.Array) -> jax.Array:
+        r = self.stem_factor
+        zin = jnp.concatenate(
+            [z.astype(self.dtype), space_to_depth(image.astype(self.dtype), r)],
+            axis=-1,
+        )
+        y = nn.relu(
+            nn.Conv(self.hidden, (3, 3), dtype=self.dtype, param_dtype=jnp.float32)(zin)
+        )
+        delta = nn.Conv(
+            self.num_classes * r * r,
+            (3, 3),
+            dtype=self.head_dtype,
+            param_dtype=jnp.float32,
+        )(y.astype(self.head_dtype))
+        return z + delta
+
+
+def group_labels(labels: jax.Array, r: int) -> jax.Array:
+    """[..., H, W] int labels → [..., H/r, W/r, r²], phase-major — the label
+    grouping that matches the channel order of pre-depth_to_space logits
+    [..., H/r, W/r, r²·C] (reshape to [..., r², C] pairs phase p's class row
+    with this function's phase-p label).  With it, the train path can run
+    losses/metrics on the grouped view — identical math to full resolution,
+    same multiset of (logit row, label) pairs — without the d2s transpose or
+    any full-res tensor (ModelConfig.train_head_layout='grouped')."""
+    *lead, h, w = labels.shape
+    if h % r or w % r:
+        raise ValueError(f"spatial dims {(h, w)} not divisible by r={r}")
+    x = labels.reshape(*lead, h // r, r, w // r, r)
+    x = jnp.moveaxis(x, -3, -2)  # [..., h/r, w/r, r, r]
+    return x.reshape(*lead, h // r, w // r, r * r)
+
+
 def upsample_2x(x: jax.Array, method: str = "bilinear") -> jax.Array:
     """2× spatial upsample of NHWC via jax.image.resize."""
     n, h, w, c = x.shape

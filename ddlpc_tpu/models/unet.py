@@ -21,6 +21,7 @@ from ddlpc_tpu.models.layers import (
     DetailHead,
     DoubleConv,
     DownBlock,
+    StemGridDetailHead,
     UpBlock,
     apply_stem,
     head_channels,
@@ -39,9 +40,20 @@ class UNet(nn.Module):
     norm_groups: int = 8
     stem: str = "none"  # none | s2d (see ModelConfig.stem)
     stem_factor: int = 2
-    # Full-resolution residual refinement after the subpixel head — restores
-    # sub-stem_factor-px structure the 1/r pyramid cannot carry (DetailHead).
+    # Residual refinement after the subpixel head — restores
+    # sub-stem_factor-px structure the 1/r pyramid cannot carry.  Kind
+    # selects the architecture: 'fullres' = DetailHead (two full-res convs),
+    # 's2d' = StemGridDetailHead (same idea computed at the stem grid on
+    # MXU-shaped channels) — see ModelConfig.detail_head_kind.
     detail_head: bool = False
+    detail_head_kind: str = "fullres"  # fullres | s2d
+    detail_head_hidden: int = 16
+    # 'grouped': under train=True with an s2d stem, return pre-d2s
+    # phase-major logits [B,H/r,W/r,r²·C] instead of full-res — the train
+    # step pairs them with group_labels for identical loss math without any
+    # full-res tensor (ModelConfig.train_head_layout).  Eval/predict
+    # (train=False) always return full-res logits.
+    train_head_layout: str = "fullres"  # fullres | grouped
     dtype: Any = jnp.bfloat16
     head_dtype: Any = jnp.float32  # see ModelConfig.head_dtype
 
@@ -73,16 +85,40 @@ class UNet(nn.Module):
             x = UpBlock(self._w(f), up_sample_mode=self.up_sample_mode, **common)(
                 x, skip, train
             )
-        logits = nn.Conv(
+        z = nn.Conv(
             head_channels(self.num_classes, self.stem, self.stem_factor),
             (1, 1),
             dtype=self.head_dtype,
             param_dtype=jnp.float32,
         )(x.astype(self.head_dtype))
-        logits = restore_head(logits, self.stem, self.stem_factor)
-        if self.detail_head:
+        if self.detail_head and self.detail_head_kind == "s2d":
+            if self.stem != "s2d":
+                raise ValueError(
+                    "detail_head_kind='s2d' refines the pre-d2s logit grid — "
+                    "it requires stem='s2d' (with stem='none' there is no "
+                    "stem grid; use detail_head_kind='fullres')"
+                )
+            z = StemGridDetailHead(
+                self.num_classes,
+                self.stem_factor,
+                hidden=self.detail_head_hidden,
+                dtype=self.dtype,
+                head_dtype=self.head_dtype,
+            )(z, image)
+        if (
+            train
+            and self.train_head_layout == "grouped"
+            and self.stem == "s2d"
+            and not (self.detail_head and self.detail_head_kind == "fullres")
+        ):
+            # Phase-major grouped logits: d2s is a pure layout permutation,
+            # so the grouped loss path skips it entirely (train_head_layout).
+            return z
+        logits = restore_head(z, self.stem, self.stem_factor)
+        if self.detail_head and self.detail_head_kind == "fullres":
             logits = DetailHead(
                 self.num_classes,
+                hidden=self.detail_head_hidden,
                 dtype=self.dtype,
                 head_dtype=self.head_dtype,
             )(logits, image)

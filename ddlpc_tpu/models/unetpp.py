@@ -27,6 +27,7 @@ from flax import linen as nn
 from ddlpc_tpu.models.layers import (
     DetailHead,
     DoubleConv,
+    StemGridDetailHead,
     UpBlock,
     apply_stem,
     head_channels,
@@ -50,13 +51,20 @@ class UNetPP(nn.Module):
     # becomes a subpixel head.  'none' is the paper-layout default.
     stem: str = "none"  # none | s2d
     stem_factor: int = 2
-    # One SHARED full-res DetailHead refines every supervision head's
-    # logits — sharing is a PARAMETER economy (one module, consistent
-    # refinement across heads); the refinement COMPUTE still runs once per
-    # supervision head (depth-1 times per step), measured −43% throughput
-    # on the s2d×4 zoo row (678 → 383 tiles/s/chip at B=96).  Opt-in for
-    # fine-structure tasks; see ModelConfig.detail_head / UNet.
+    # One SHARED refinement head (DetailHead or StemGridDetailHead per
+    # ``detail_head_kind``) — sharing is a PARAMETER economy (one module,
+    # consistent refinement across heads).  ``detail_head_scope``:
+    # 'per_head' runs the refinement compute once per supervision head
+    # (depth-1 times per step — measured −43% throughput on the s2d×4 zoo
+    # row, 678 → 383 tiles/s/chip at B=96); 'ensemble' refines ONLY the
+    # ensemble-mean readout, which joins the deep-supervision loss as one
+    # extra supervised output and is exactly what inference returns.
     detail_head: bool = False
+    detail_head_kind: str = "fullres"  # fullres | s2d
+    detail_head_hidden: int = 16
+    detail_head_scope: str = "per_head"  # per_head | ensemble
+    # See UNet.train_head_layout / ModelConfig.train_head_layout.
+    train_head_layout: str = "fullres"  # fullres | grouped
     dtype: Any = jnp.bfloat16
     head_dtype: Any = jnp.float32  # see ModelConfig.head_dtype
 
@@ -104,38 +112,119 @@ class UNetPP(nn.Module):
                     **common,
                 )(grid[(i + 1, j - 1)], skips, train)
 
-        refine = (
-            DetailHead(
-                self.num_classes,
-                dtype=self.dtype,
-                head_dtype=self.head_dtype,
-                name="detail_head",
-            )
-            if self.detail_head
-            else None
+        # Shared refinement module (parameter economy across heads); the
+        # kind decides which grid it runs on (see ModelConfig).
+        s2d_refine = px_refine = None
+        if self.detail_head:
+            if self.detail_head_kind == "s2d":
+                if self.stem != "s2d":
+                    raise ValueError(
+                        "detail_head_kind='s2d' requires stem='s2d' "
+                        "(see ModelConfig.detail_head_kind)"
+                    )
+                s2d_refine = StemGridDetailHead(
+                    self.num_classes,
+                    self.stem_factor,
+                    hidden=self.detail_head_hidden,
+                    dtype=self.dtype,
+                    head_dtype=self.head_dtype,
+                    name="detail_head",
+                )
+            else:
+                px_refine = DetailHead(
+                    self.num_classes,
+                    hidden=self.detail_head_hidden,
+                    dtype=self.dtype,
+                    head_dtype=self.head_dtype,
+                    name="detail_head",
+                )
+        # With a single head there is no ensemble to refine separately —
+        # scope='ensemble' degenerates to per_head.
+        ensemble_scope = (
+            self.detail_head
+            and self.detail_head_scope == "ensemble"
+            and self.deep_supervision
         )
 
-        def head(h: jax.Array, name: str) -> jax.Array:
-            logits = nn.Conv(
+        def head_z(h: jax.Array, name: str) -> jax.Array:
+            """Pre-restore (stem-grid) logits of one supervision head."""
+            return nn.Conv(
                 head_channels(self.num_classes, self.stem, self.stem_factor),
                 (1, 1),
                 dtype=self.head_dtype,
                 param_dtype=jnp.float32,
                 name=name,
             )(h.astype(self.head_dtype))
-            logits = restore_head(logits, self.stem, self.stem_factor)
-            if refine is not None:
-                logits = refine(logits, image)
+
+        def to_pixel(z: jax.Array, refine: bool) -> jax.Array:
+            logits = restore_head(z, self.stem, self.stem_factor)
+            if refine and px_refine is not None:
+                logits = px_refine(logits, image)
             return logits
 
         if self.deep_supervision:
-            logits = jnp.stack(
-                [head(grid[(0, j)], f"head_{j}") for j in range(1, depth)]
+            zs = [head_z(grid[(0, j)], f"head_{j}") for j in range(1, depth)]
+        else:
+            zs = [head_z(grid[(0, depth - 1)], "head")]
+
+        if s2d_refine is not None and not ensemble_scope:
+            zs = [s2d_refine(z, image) for z in zs]
+
+        # scope='ensemble': ONE refinement pass on the ensemble-mean readout
+        # (the exact logits inference returns); under train it joins the
+        # stacked outputs as one extra supervised term of the mean loss.
+        ens_z = ens_px = None
+        if ensemble_scope:
+            ens = (
+                jnp.mean(jnp.stack(zs).astype(jnp.float32), axis=0).astype(
+                    self.head_dtype
+                )
+                if len(zs) > 1
+                else zs[0]
             )
-            # Ensemble-mean readout in fp32 regardless of head storage dtype.
-            return (
-                logits
-                if train
-                else jnp.mean(logits.astype(jnp.float32), axis=0)
+            if s2d_refine is not None:
+                ens_z = s2d_refine(ens, image)
+            else:
+                ens_px = px_refine(
+                    restore_head(ens, self.stem, self.stem_factor), image
+                )
+
+        grouped = (
+            train
+            and self.train_head_layout == "grouped"
+            and self.stem == "s2d"
+            and px_refine is None
+        )
+        if train:
+            if grouped:
+                outs = zs + ([ens_z] if ens_z is not None else [])
+            else:
+                outs = [to_pixel(z, refine=not ensemble_scope) for z in zs]
+                if ens_z is not None:
+                    outs.append(restore_head(ens_z, self.stem, self.stem_factor))
+                elif ens_px is not None:
+                    outs.append(ens_px)
+            # Deep supervision always returns the stacked per-head logits
+            # (loss = mean of per-head CEs via label broadcasting).
+            return jnp.stack(outs) if self.deep_supervision else outs[0]
+
+        # Inference: the ensemble readout.
+        if ens_z is not None:
+            return restore_head(ens_z, self.stem, self.stem_factor)
+        if ens_px is not None:
+            return ens_px
+        if px_refine is None:
+            # depth_to_space is a pure permutation, so the ensemble mean
+            # commutes with it: average at the stem grid (fp32) and restore
+            # ONCE instead of materializing J full-res tensors.
+            z = (
+                zs[0]
+                if len(zs) == 1
+                else jnp.mean(jnp.stack(zs).astype(jnp.float32), axis=0)
             )
-        return head(grid[(0, depth - 1)], "head")
+            return restore_head(z, self.stem, self.stem_factor)
+        logits = [to_pixel(z, refine=True) for z in zs]
+        if len(logits) == 1:
+            return logits[0]
+        # Ensemble-mean readout in fp32 regardless of head storage dtype.
+        return jnp.mean(jnp.stack(logits).astype(jnp.float32), axis=0)

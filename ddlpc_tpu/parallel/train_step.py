@@ -33,6 +33,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ddlpc_tpu.config import CompressionConfig, ExperimentConfig
+from ddlpc_tpu.models.layers import group_labels
 from ddlpc_tpu.ops.losses import softmax_cross_entropy, softmax_cross_entropy_sum
 from ddlpc_tpu.ops.metrics import confusion_from_logits, pixel_accuracy
 from ddlpc_tpu.parallel.grad_sync import sync_gradients
@@ -105,6 +106,15 @@ def _loss_and_metrics(
     else:
         logits = model.apply(variables, images, train=False)
         new_stats = batch_stats
+    # train_head_layout='grouped': the model returned pre-d2s phase-major
+    # logits [..., H/r, W/r, r²·C] (models/layers.py:group_labels).  Group
+    # the labels the same way and run the SAME loss/metric functions on the
+    # [..., r², C] view — identical math (same multiset of (logit row,
+    # label) pairs), no full-res tensor or d2s transpose in the train graph.
+    if logits.shape[-3:-1] != labels.shape[-2:]:
+        r = labels.shape[-2] // logits.shape[-3]
+        labels = group_labels(labels, r)
+        logits = logits.reshape(*logits.shape[:-1], r * r, -1)
     # -1 marks void/ignored pixels (e.g. Cityscapes' unlabeled classes,
     # scripts/prepare_cityscapes.py); they contribute neither loss nor
     # accuracy.  Datasets without voids have no -1 labels, so this is a

@@ -68,15 +68,27 @@ def run_variant(
     head_dtype: str = "float32",
     learning_rate: float = 1e-3,
     detail_head: bool = False,
+    detail_head_kind: str = "fullres",
+    detail_head_hidden: int = 16,
+    train_head_layout: str = "fullres",
+    model_name: str = "unet",
+    deep_supervision: bool = False,
+    detail_head_scope: str = "per_head",
 ) -> dict:
     cfg = ExperimentConfig(
         model=ModelConfig(
+            name=model_name,
             width_divisor=2,
             num_classes=6,
             stem="s2d" if stem_factor > 1 else "none",
             stem_factor=max(stem_factor, 2),
             head_dtype=head_dtype,
             detail_head=detail_head,
+            detail_head_kind=detail_head_kind,
+            detail_head_hidden=detail_head_hidden,
+            train_head_layout=train_head_layout,
+            deep_supervision=deep_supervision,
+            detail_head_scope=detail_head_scope,
         ),
         data=DataConfig(image_size=image_size),
         train=TrainConfig(

@@ -1,0 +1,90 @@
+"""DetailHead capacity/design sweep on the hard task (VERDICT r3 next #3).
+
+Round 3 shipped the only refinement point ever trained (full-res hidden=16,
+hard-task mIoU 0.897 at 120 epochs vs the 0.991 matched-budget full-res
+anchor) without attempting a sweep.  This script runs the round-4 Pareto
+candidates at EXACTLY the r3 extended-budget protocol (micro 8 × sync 4,
+lr 1e-3, fp16 codec, 120 epochs, synthetic_hard 512²,
+docs/convergence_ab_hard120/) so every row is comparable to the committed
+r3 table:
+
+- full-res DetailHead at hidden 32 / 64 (capacity sweep of the r3 design);
+- StemGridDetailHead (detail_head_kind='s2d') at hidden 32 / 64 / 128 with
+  the grouped train layout — the round-4 fused-head candidates
+  (scripts/head_bench.py measures their throughput side).
+
+Writes per-arm JSONL + merged summary into --outdir (default the r3
+directory, tags keep arms distinct).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_SCRIPTS_DIR))
+sys.path.insert(0, _SCRIPTS_DIR)
+
+from convergence_ab import run_variant  # noqa: E402
+
+ARMS = {
+    # r3 design, more capacity.
+    "stem4_detail_h32_hard": dict(detail_head=True, detail_head_hidden=32),
+    "stem4_detail_h64_hard": dict(detail_head=True, detail_head_hidden=64),
+    # Stem-grid refinement (s2d kind) + grouped train layout.
+    "stem4_s2dhead_h16_hard": dict(
+        detail_head=True, detail_head_kind="s2d", detail_head_hidden=16,
+        train_head_layout="grouped",
+    ),
+    "stem4_s2dhead_h32_hard": dict(
+        detail_head=True, detail_head_kind="s2d", detail_head_hidden=32,
+        train_head_layout="grouped",
+    ),
+    "stem4_s2dhead_h64_hard": dict(
+        detail_head=True, detail_head_kind="s2d", detail_head_hidden=64,
+        train_head_layout="grouped",
+    ),
+    "stem4_s2dhead_h128_hard": dict(
+        detail_head=True, detail_head_kind="s2d", detail_head_hidden=128,
+        train_head_layout="grouped",
+    ),
+}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=120)
+    p.add_argument("--outdir", default="docs/convergence_ab_hard120")
+    p.add_argument("--only", default="")
+    args = p.parse_args()
+
+    tags = [t for t in args.only.split(",") if t] or list(ARMS)
+    results = []
+    for tag in tags:
+        rec = run_variant(
+            tag,
+            4,
+            "float16",
+            args.epochs,
+            args.outdir,
+            dataset="synthetic_hard",
+            **ARMS[tag],
+        )
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    summary_path = os.path.join(args.outdir, "summary.json")
+    merged = {}
+    if os.path.exists(summary_path):
+        with open(summary_path) as f:
+            merged = {r["tag"]: r for r in json.load(f)}
+    merged.update({r["tag"]: r for r in results})
+    with open(summary_path, "w") as f:
+        json.dump(list(merged.values()), f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

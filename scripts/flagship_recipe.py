@@ -54,6 +54,13 @@ def main() -> None:
     p.add_argument("--rounding", default="nearest")
     p.add_argument("--head-dtype", default="bfloat16",
                    help="fp32 arm isolates the bf16-head quality cost")
+    p.add_argument("--detail-kind", default="fullres",
+                   help="detail_head_kind: fullres | s2d (round-4 head)")
+    p.add_argument("--detail-hidden", type=int, default=16)
+    p.add_argument("--head-layout", default="fullres",
+                   help="train_head_layout: fullres | grouped")
+    p.add_argument("--tag-suffix", default="",
+                   help="extra tag suffix distinguishing arch variants")
     args = p.parse_args()
 
     results = []
@@ -63,6 +70,17 @@ def main() -> None:
             tag += f"_{args.mode}_{args.rounding}"
         if args.head_dtype != "bfloat16":
             tag += f"_head{args.head_dtype}"
+        # Arch axes auto-encode into the tag like the codec axes do — two
+        # arms must never share a tag (run_variant truncates {tag}.jsonl and
+        # the summary merge is by tag, so a collision would overwrite the
+        # control arm's committed curve).
+        if args.detail_kind != "fullres":
+            tag += f"_{args.detail_kind}h{args.detail_hidden}"
+        elif args.detail_hidden != 16:
+            tag += f"_h{args.detail_hidden}"
+        if args.head_layout != "fullres":
+            tag += f"_{args.head_layout}"
+        tag += args.tag_suffix
         rec = run_variant(
             tag,
             args.stem_factor,
@@ -74,6 +92,9 @@ def main() -> None:
             dataset="synthetic_hard",
             head_dtype=args.head_dtype,
             detail_head=True,
+            detail_head_kind=args.detail_kind,
+            detail_head_hidden=args.detail_hidden,
+            train_head_layout=args.head_layout,
             learning_rate=lr,
             rounding=args.rounding,
         )

@@ -1,0 +1,151 @@
+"""Throughput A/B of the round-4 head-region candidates on the real chip.
+
+VERDICT r3 next #2: the roofline attributes 0.13 s of the 0.30 s flagship
+step to the full-resolution head region (DetailHead weight-gradient
+contractions over [B,512²] ~65 ms, full-res loss/metric reductions ~25 ms,
+subpixel layout copies ~18 ms — docs/roofline/flagship.json).  Round 4
+attacks it at the XLA level instead of hand-writing a Pallas kernel:
+
+- ``detail_head_kind='s2d'`` (StemGridDetailHead): the refinement convs run
+  at the stem grid on MXU-shaped channels (144→hidden→96 at 128² instead of
+  9→16→6 at 512²);
+- ``train_head_layout='grouped'``: the train path pairs pre-d2s phase-major
+  logits with identically grouped labels — same math, no d2s transpose, no
+  full-res tensor anywhere in the train graph.
+
+This script measures each candidate through bench.py's pipelined harness
+(same warmup/pipeline/fetch discipline) and writes
+docs/head_bench/results.json.  Usage:
+    python scripts/head_bench.py [--rounds 3] [--only tag1,tag2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_SCRIPTS_DIR))
+
+import bench  # noqa: E402
+
+# All candidates share the flagship operating point (512² tiles, fp16
+# codec, bf16 head, B=128/chip × sync 4) so differences are the head alone.
+_BASE = dict(
+    image=(512, 512),
+    micro_batch=128,
+    sync_period=4,
+    compression="float16",
+)
+_MODEL = dict(
+    width_divisor=2, num_classes=6, stem="s2d", stem_factor=4,
+    head_dtype="bfloat16",
+)
+
+CANDIDATES = {
+    # Round-3 shipped flagship, re-measured in-session as the control.
+    "fullres_h16": dict(
+        _BASE, model=dict(_MODEL, detail_head=True, detail_head_hidden=16)
+    ),
+    # Grouped loss alone on the QUALITY-BROKEN plain head (no refinement):
+    # bounds what the layout change is worth independent of the head swap.
+    "plain_grouped": dict(
+        _BASE, model=dict(_MODEL, train_head_layout="grouped")
+    ),
+    # Full-res refinement capacity points (the quality sweep's arms need
+    # their throughput side for the Pareto table).
+    "fullres_h32": dict(
+        _BASE, model=dict(_MODEL, detail_head=True, detail_head_hidden=32)
+    ),
+    "fullres_h64": dict(
+        _BASE, model=dict(_MODEL, detail_head=True, detail_head_hidden=64)
+    ),
+    # Stem-grid refinement at four capacities, grouped loss.
+    "s2d_h16_grouped": dict(
+        _BASE,
+        model=dict(
+            _MODEL, detail_head=True, detail_head_kind="s2d",
+            detail_head_hidden=16, train_head_layout="grouped",
+        ),
+    ),
+    "s2d_h32_grouped": dict(
+        _BASE,
+        model=dict(
+            _MODEL, detail_head=True, detail_head_kind="s2d",
+            detail_head_hidden=32, train_head_layout="grouped",
+        ),
+    ),
+    "s2d_h64_grouped": dict(
+        _BASE,
+        model=dict(
+            _MODEL, detail_head=True, detail_head_kind="s2d",
+            detail_head_hidden=64, train_head_layout="grouped",
+        ),
+    ),
+    "s2d_h128_grouped": dict(
+        _BASE,
+        model=dict(
+            _MODEL, detail_head=True, detail_head_kind="s2d",
+            detail_head_hidden=128, train_head_layout="grouped",
+        ),
+    ),
+    # s2d refinement WITHOUT the grouped loss (isolates the two effects).
+    # NOT in the default list: at B=128 this arm materializes the fp32
+    # d2s-restored logits on top of the s2d head's activations and hung the
+    # device for >10 min (the r3 HBM-overflow failure mode) — run it only
+    # at a reduced --micro-batch.
+    "s2d_h64_fullres": dict(
+        _BASE,
+        model=dict(
+            _MODEL, detail_head=True, detail_head_kind="s2d",
+            detail_head_hidden=64,
+        ),
+    ),
+}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--rounds", type=int, default=3)
+    p.add_argument("--only", default="")
+    p.add_argument("--outdir", default="docs/head_bench")
+    p.add_argument(
+        "--micro-batch", type=int, default=0,
+        help="override the shared per-chip micro-batch (B sweep)",
+    )
+    p.add_argument(
+        "--sync-period", type=int, default=0,
+        help="override sync_period (amortizes the codec+Adam epilogue over "
+        "more micro-batches; changes the global batch => needs its own LR "
+        "evidence before shipping)",
+    )
+    args = p.parse_args()
+
+    tags = [t for t in args.only.split(",") if t] or [
+        t for t in CANDIDATES if t != "s2d_h64_fullres"
+    ]
+    os.makedirs(args.outdir, exist_ok=True)
+    out_path = os.path.join(args.outdir, "results.json")
+    results = {}
+    if os.path.exists(out_path):
+        results = {r["tag"]: r for r in json.load(open(out_path))}
+    for tag in tags:
+        spec = dict(CANDIDATES[tag])
+        if args.micro_batch:
+            spec["micro_batch"] = args.micro_batch
+            tag = f"{tag}_b{args.micro_batch}"
+        if args.sync_period:
+            spec["sync_period"] = args.sync_period
+            tag = f"{tag}_s{args.sync_period}"
+        bench.BENCHES[tag] = spec
+        rec = dict(bench.run_bench(tag, args.rounds), tag=tag)
+        results[tag] = rec
+        print(json.dumps(rec), flush=True)
+    with open(out_path, "w") as f:
+        json.dump(list(results.values()), f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,16 +1,17 @@
-"""The REAL multi-process data path, end to end (VERDICT r2 next #4).
+"""The REAL multi-process data path, end to end (VERDICT r2 #4, r3 #5).
 
 `multiproc_smoke.py` proves the bootstrap + compiled SPMD step across two
 OS processes, but it builds batches with `jax.make_array_from_callback`,
 bypassing the production loader.  This script drives the actual `Trainer`
-across 2 processes — the one code path that would feed a multi-host pod:
+across N processes — the one code path that would feed a multi-host pod:
 
 - `ShardedLoader._local_batches` per-process slicing (loader.py) with
   `jax.process_index() > 0` actually taken: a recording dataset wrapper
   captures the tile indices each process gathers, and the ranks allgather
-  them to assert the shards are DISJOINT and cover the epoch permutation —
-  the property whose absence makes the reference do k× redundant work
-  (its shuffle is computed then never applied, кластер.py:722-723,750);
+  them to assert the shards are PAIRWISE DISJOINT and cover the epoch
+  permutation — the property whose absence makes the reference do k×
+  redundant work (its shuffle is computed then never applied,
+  кластер.py:722-723,750);
 - sharded evaluation through `eval_batches`' per-process slice;
 - checkpoint save (process 0 writes) + `Trainer(resume=True)` through
   `_restore_synchronized`'s REAL `broadcast_one_to_all` path (no
@@ -18,7 +19,16 @@ across 2 processes — the one code path that would feed a multi-host pod:
   across processes and to the pre-save state, and the epoch count must
   continue.
 
-Usage: python scripts/multiproc_trainer.py   (parent; spawns both ranks)
+Round-4 extensions (VERDICT r3 weak #4: "multi-process coverage stops at
+N=2 and at fixed tiles"):
+- ``--procs N`` runs the same proof over N OS processes (default 2; the
+  r3 topology was exactly 2 — pairing, not fan-in);
+- ``--crops`` swaps the fixed-tile synthetic dataset for the
+  CropDataset + DihedralAugment pipeline (epoch-deterministic crop plan and
+  augmentation draws shared across processes) — the host gather path a pod
+  would run for scene-sized imagery.
+
+Usage: python scripts/multiproc_trainer.py [--procs 4] [--crops]
 """
 
 from __future__ import annotations
@@ -30,19 +40,27 @@ import tempfile
 import time
 
 
-def child(rank: int, port: int, workdir: str) -> None:
+def child(rank: int, port: int, workdir: str, procs: int, crops: bool) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)  # 2 local -> 4 global devices
+    # N=2 procs × 2 local devices (the r3 layout) and N=4 procs × 1 local
+    # device run the SAME 4-device SPMD program over more process
+    # boundaries; N=8 procs × 1 local device widens the mesh to 8 (micro
+    # batch 1/replica).  main() restricts --procs to {2, 4, 8} so the
+    # global micro-batch of 8 always divides evenly.
+    local_devices = max(1, 4 // procs)
+    jax.config.update("jax_num_cpu_devices", local_devices)
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from ddlpc_tpu.parallel.mesh import initialize_distributed
 
     initialize_distributed(
-        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=rank
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=procs,
+        process_id=rank,
     )
-    assert jax.process_count() == 2
+    assert jax.process_count() == procs
 
     import numpy as np
     import jax.numpy as jnp
@@ -55,42 +73,70 @@ def child(rank: int, port: int, workdir: str) -> None:
         ParallelConfig,
         TrainConfig,
     )
-    from ddlpc_tpu.data.datasets import TileDataset
     from ddlpc_tpu.train.trainer import Trainer
 
-    cfg = ExperimentConfig(
-        model=ModelConfig(
-            features=(8,), bottleneck_features=8, num_classes=3, norm="group"
-        ),
-        data=DataConfig(
+    n_dev = procs * local_devices
+    if crops:
+        # Scene crops + dihedral augmentation: the host gather path.
+        # 32 crops/epoch = 2 super-batches of 16, no wrap-fill.
+        data = DataConfig(
+            dataset="synthetic",
+            image_size=(32, 32),
+            crops_per_epoch=32,
+            test_split_scenes=1,
+            test_split=8,
+            augment=True,
+            num_classes=3,
+        )
+    else:
+        data = DataConfig(
             dataset="synthetic",
             image_size=(32, 32),
             synthetic_len=24,
             test_split=8,
             num_classes=3,
+        )
+    cfg = ExperimentConfig(
+        model=ModelConfig(
+            features=(8,), bottleneck_features=8, num_classes=3, norm="group"
         ),
+        data=data,
         train=TrainConfig(
             epochs=2,
-            micro_batch_size=2,  # global micro 8 over the 4-device data axis
+            micro_batch_size=8 // n_dev,  # global micro 8 over the data axis
             sync_period=2,
             dump_images_per_epoch=0,
             checkpoint_every_epochs=1,
             eval_every_epochs=1,
         ),
-        parallel=ParallelConfig(data_axis_size=4),
+        parallel=ParallelConfig(data_axis_size=n_dev),
         workdir=workdir,
     )
 
-    class RecordingDataset(TileDataset):
-        """Records every index this process's loader actually gathers."""
+    class RecordingDataset:
+        """Records every index this process's loader actually gathers.
 
-        def __init__(self, base: TileDataset):
-            super().__init__(base.images, base.labels)
+        Generic delegation wrapper (not a TileDataset subclass) so it wraps
+        the fixed-tile dataset AND the CropDataset/DihedralAugment stack.
+        """
+
+        def __init__(self, base):
+            self.base = base
             self.seen: list = []
+
+        def __len__(self):
+            return len(self.base)
+
+        def set_epoch(self, epoch):
+            self.base.set_epoch(epoch)
+
+        @property
+        def image_shape(self):
+            return self.base.image_shape
 
         def gather(self, indices):
             self.seen.append(np.asarray(indices).copy())
-            return super().gather(indices)
+            return self.base.gather(indices)
 
     trainer = Trainer(cfg, resume=False)
     rec = RecordingDataset(trainer.loader.ds)
@@ -98,23 +144,28 @@ def child(rank: int, port: int, workdir: str) -> None:
     final = trainer.fit()
     assert "val_miou" in final, final  # sharded eval ran
 
-    # --- per-process shards are disjoint per super-batch -----------------
-    # Each gather call is one super-batch's local slice; comparing the two
-    # ranks' slices of the SAME super-batch must show no overlap (within an
-    # epoch processes must never duplicate work) and their union must be the
-    # full global super-batch.
+    # --- per-process shards are pairwise disjoint per super-batch ---------
+    # Each gather call is one super-batch's local slice; across the N ranks
+    # the slices of the SAME super-batch must not overlap (within an epoch
+    # processes must never duplicate work) and their union must be the full
+    # global super-batch.
     seen = np.stack(rec.seen)  # [num_super_batches_total, A*B_local]
-    g = multihost_utils.process_allgather(seen)  # [2, n, A*B_local]
+    g = multihost_utils.process_allgather(seen)  # [procs, n, A*B_local]
     sb = trainer.loader.super_batch
     for t in range(seen.shape[0]):
-        s0, s1 = set(g[0][t].tolist()), set(g[1][t].tolist())
-        assert not (s0 & s1), f"super-batch {t}: ranks gathered overlapping tiles"
-        assert len(s0 | s1) == min(sb, len(trainer.train_ds)), (
-            f"super-batch {t}: union {len(s0 | s1)} != global super-batch"
+        sets = [set(g[r][t].tolist()) for r in range(procs)]
+        for a in range(procs):
+            for b in range(a + 1, procs):
+                assert not (sets[a] & sets[b]), (
+                    f"super-batch {t}: ranks {a},{b} gathered overlapping tiles"
+                )
+        union = set().union(*sets)
+        assert len(union) == min(sb, len(trainer.train_ds)), (
+            f"super-batch {t}: union {len(union)} != global super-batch"
         )
     assert set(np.unique(seen)) <= set(range(len(trainer.train_ds)))
 
-    # --- replicated state agrees across processes ------------------------
+    # --- replicated state agrees across all processes ---------------------
     def digest(state):
         flat = jnp.concatenate(
             [jnp.ravel(l) for l in jax.tree.leaves(state.params)]
@@ -123,7 +174,8 @@ def child(rank: int, port: int, workdir: str) -> None:
 
     d_final = digest(trainer.state)
     g = multihost_utils.process_allgather(d_final)
-    assert np.array_equal(g[0], g[1]), "post-training params diverged"
+    for r in range(1, procs):
+        assert np.array_equal(g[0], g[r]), f"post-training params diverged (rank {r})"
 
     # --- restart: REAL synchronized resume -------------------------------
     resumed = Trainer(cfg, resume=True)
@@ -133,14 +185,30 @@ def child(rank: int, port: int, workdir: str) -> None:
         "resumed state != saved state (rank %d)" % rank
     )
     g2 = multihost_utils.process_allgather(d_resumed)
-    assert np.array_equal(g2[0], g2[1]), "resumed params diverged across ranks"
+    for r in range(1, procs):
+        assert np.array_equal(g2[0], g2[r]), "resumed params diverged across ranks"
 
-    print(f"[rank {rank}] trainer-e2e OK (epochs resumed at {resumed.start_epoch})",
-          flush=True)
+    print(
+        f"[rank {rank}/{procs}] trainer-e2e OK "
+        f"(crops={crops}, epochs resumed at {resumed.start_epoch})",
+        flush=True,
+    )
 
 
 def main() -> int:
+    import argparse
     import socket
+
+    p = argparse.ArgumentParser()
+    p.add_argument(
+        "--procs", type=int, default=2, choices=(2, 4, 8),
+        help="process count; the global micro-batch of 8 must divide evenly "
+        "over procs × local devices, so only 2 (r3 topology), 4 and 8 keep "
+        "the proof's SPMD program intact",
+    )
+    p.add_argument("--crops", action="store_true")
+    p.add_argument("--timeout", type=float, default=900.0)
+    args = p.parse_args()
 
     sock = socket.socket()
     sock.bind(("127.0.0.1", 0))
@@ -156,11 +224,13 @@ def main() -> int:
                 str(r),
                 str(port),
                 workdir,
+                str(args.procs),
+                "1" if args.crops else "0",
             ]
         )
-        for r in range(2)
+        for r in range(args.procs)
     ]
-    deadline = time.monotonic() + 480
+    deadline = time.monotonic() + args.timeout
     try:
         rcs = [p.wait(timeout=max(deadline - time.monotonic(), 1.0)) for p in procs]
     except subprocess.TimeoutExpired:
@@ -173,13 +243,19 @@ def main() -> int:
     if any(rcs):
         print(f"FAILED: exit codes {rcs}", file=sys.stderr)
         return 1
-    print("multiproc trainer OK")
+    print(f"multiproc trainer OK (procs={args.procs}, crops={args.crops})")
     return 0
 
 
 if __name__ == "__main__":
     if "--rank" in sys.argv:
         i = sys.argv.index("--rank")
-        child(int(sys.argv[i + 1]), int(sys.argv[i + 2]), sys.argv[i + 3])
+        child(
+            int(sys.argv[i + 1]),
+            int(sys.argv[i + 2]),
+            sys.argv[i + 3],
+            int(sys.argv[i + 4]),
+            sys.argv[i + 5] == "1",
+        )
     else:
         sys.exit(main())

@@ -30,7 +30,7 @@ import sys
 import time
 
 
-def child(rank: int, port: int, elements: int, out: str) -> None:
+def child(rank: int, port: int, elements: int, out: str, procs: int) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -41,7 +41,7 @@ def child(rank: int, port: int, elements: int, out: str) -> None:
     from ddlpc_tpu.parallel.mesh import initialize_distributed
 
     initialize_distributed(
-        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=rank
+        coordinator_address=f"127.0.0.1:{port}", num_processes=procs, process_id=rank
     )
     import jax.numpy as jnp
     import numpy as np
@@ -57,8 +57,8 @@ def child(rank: int, port: int, elements: int, out: str) -> None:
     from ddlpc_tpu.parallel.mesh import make_mesh
     from ddlpc_tpu.config import ParallelConfig
 
-    mesh = make_mesh(ParallelConfig(data_axis_size=2))
-    n_dev = 2
+    mesh = make_mesh(ParallelConfig(data_axis_size=procs))
+    n_dev = procs
 
     rng = np.random.default_rng(rank)
     local = jnp.asarray(rng.normal(size=(elements,)).astype(np.float32))
@@ -88,7 +88,7 @@ def child(rank: int, port: int, elements: int, out: str) -> None:
                     check_vma=False,
                 )
             )
-            g = jnp.concatenate([local, local])  # global [2e] sharded over 2
+            g = jnp.concatenate([local] * n_dev)  # global [n·e] sharded over n
             float(f(g))  # compile + warm
             reps = []
             for _ in range(3):
@@ -122,7 +122,7 @@ def child(rank: int, port: int, elements: int, out: str) -> None:
     if rank == 0:
         report = {
             "elements": elements,
-            "processes": 2,
+            "processes": procs,
             "wall_ms_per_sync": rows,
             "wire": {
                 "ring_int8": ring_wire_report(elements, n_dev, int8_cfg),
@@ -137,14 +137,27 @@ def child(rank: int, port: int, elements: int, out: str) -> None:
             ),
         }
         os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        # Merge by process count: the artifact holds one row per measured
+        # ring size (N=2 pairing, N=4 fan-in, ... — VERDICT r3 #5).
+        rows_all = []
+        if os.path.exists(out):
+            with open(out) as f:
+                prev = json.load(f)
+            rows_all = prev if isinstance(prev, list) else [prev]
+        rows_all = [r for r in rows_all if r.get("processes") != procs]
+        rows_all.append(report)
+        rows_all.sort(key=lambda r: r.get("processes", 0))
         with open(out, "w") as f:
-            json.dump(report, f, indent=2)
+            json.dump(rows_all, f, indent=2)
         print(json.dumps({k: v for k, v in report.items() if k != "note"}))
 
 
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--elements", type=int, default=4_000_000)
+    p.add_argument("--procs", type=int, default=2,
+                   help="process count == ring size (VERDICT r3 #5: measure "
+                        "the ring across >2 process boundaries)")
     p.add_argument("--out", default="docs/ring_transport/measurement.json")
     args = p.parse_args()
 
@@ -164,9 +177,10 @@ def main() -> int:
                 str(port),
                 str(args.elements),
                 args.out,
+                str(args.procs),
             ]
         )
-        for r in range(2)
+        for r in range(args.procs)
     ]
     deadline = time.monotonic() + 900
     try:
@@ -193,6 +207,7 @@ if __name__ == "__main__":
             int(sys.argv[i + 2]),
             int(sys.argv[i + 3]),
             sys.argv[i + 4],
+            int(sys.argv[i + 5]),
         )
     else:
         sys.exit(main())

@@ -382,3 +382,154 @@ def test_unetpp_detail_head_learns(tmp_path):
     )
     rec = Trainer(cfg).fit()
     assert rec["val_miou"] > 0.5
+
+
+# ---- round 4: stem-grid refinement + grouped train-head layout -----------
+
+
+def test_group_labels_matches_s2d_channel_order():
+    """group_labels must pair label phase p with the channel block phase p
+    of pre-d2s logits — i.e. agree with space_to_depth's channel order."""
+    from ddlpc_tpu.models.layers import group_labels, space_to_depth
+
+    rng = np.random.default_rng(0)
+    labels = jnp.asarray(rng.integers(0, 6, (2, 8, 12)), jnp.int32)
+    for r in (2, 4):
+        via_s2d = space_to_depth(
+            labels[..., None].astype(jnp.float32), r
+        ).astype(jnp.int32)
+        np.testing.assert_array_equal(group_labels(labels, r), via_s2d)
+
+
+@pytest.mark.parametrize("detail", [False, True])
+def test_grouped_layout_loss_and_grads_identical(detail):
+    """train_head_layout='grouped' is a LAYOUT change, not a math change:
+    same params, same batch -> same loss/accuracy and (to fp reassociation)
+    same gradients as the fullres layout.  This is the exactness proof that
+    lets the grouped flagship reuse the fullres quality evidence."""
+    from ddlpc_tpu.parallel.train_step import _loss_and_metrics
+
+    def build(layout):
+        cfg = ModelConfig(
+            features=(8, 16), bottleneck_features=16, num_classes=5,
+            stem="s2d", stem_factor=4, head_dtype="bfloat16",
+            detail_head=detail, detail_head_kind="s2d",
+            detail_head_hidden=8, train_head_layout=layout,
+        )
+        return build_model(cfg)
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.random((2, 64, 64, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 5, (2, 64, 64)), jnp.int32)
+    m_full, m_grp = build("fullres"), build("grouped")
+    v = m_full.init(jax.random.PRNGKey(0), x, train=False)
+    # Identical param structure: grouping only skips the output d2s.
+    v2 = m_grp.init(jax.random.PRNGKey(0), x, train=False)
+    assert jax.tree.structure(v) == jax.tree.structure(v2)
+
+    def loss_of(model):
+        def f(params):
+            loss, (stats, acc) = _loss_and_metrics(
+                model, params, v["batch_stats"], x, y, train=True
+            )
+            return loss, acc
+        return jax.value_and_grad(f, has_aux=True)(v["params"])
+
+    (l1, a1), g1 = loss_of(m_full)
+    (l2, a2), g2 = loss_of(m_grp)
+    assert np.isclose(float(l1), float(l2), rtol=1e-5)
+    assert np.isclose(float(a1), float(a2), rtol=1e-5)
+    for p1, p2 in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(
+            np.asarray(p1, np.float64), np.asarray(p2, np.float64),
+            rtol=2e-4, atol=2e-6,
+        )
+
+
+def test_stem_grid_detail_head_learns(tmp_path):
+    """detail_head_kind='s2d' + train_head_layout='grouped' (the round-4
+    fused-head candidate) must train end to end and produce full-res logits
+    at inference."""
+    from ddlpc_tpu.config import DataConfig, ExperimentConfig, TrainConfig
+    from ddlpc_tpu.train.trainer import Trainer
+
+    cfg = ExperimentConfig(
+        model=ModelConfig(
+            features=(8, 16), bottleneck_features=16, num_classes=4,
+            stem="s2d", stem_factor=4, head_dtype="bfloat16",
+            detail_head=True, detail_head_kind="s2d", detail_head_hidden=16,
+            train_head_layout="grouped",
+        ),
+        data=DataConfig(dataset="synthetic", image_size=(64, 64),
+                        synthetic_len=40, test_split=8, num_classes=4),
+        train=TrainConfig(epochs=25, micro_batch_size=1, sync_period=2,
+                          learning_rate=3e-3, dump_images_per_epoch=0,
+                          checkpoint_every_epochs=0),
+        workdir=str(tmp_path),
+    )
+    rec = Trainer(cfg).fit()
+    assert rec["val_miou"] > 0.5
+
+
+def test_head_option_validation():
+    """Invalid layout/kind combinations are rejected at build time — a
+    config artifact must never claim semantics the network won't execute."""
+    with pytest.raises(ValueError, match="detail_head_kind"):
+        build_model(ModelConfig(detail_head=True, detail_head_kind="nope"))
+    with pytest.raises(ValueError, match="stem='s2d'"):
+        build_model(
+            ModelConfig(detail_head=True, detail_head_kind="s2d", stem="none")
+        )
+    with pytest.raises(ValueError, match="grouped"):
+        build_model(ModelConfig(train_head_layout="grouped", stem="none"))
+    with pytest.raises(ValueError, match="full-resolution DetailHead"):
+        build_model(
+            ModelConfig(
+                train_head_layout="grouped", stem="s2d",
+                detail_head=True, detail_head_kind="fullres",
+            )
+        )
+    with pytest.raises(ValueError, match="grouped"):
+        build_model(
+            ModelConfig(name="deeplabv3p", train_head_layout="grouped",
+                        stem="s2d")
+        )
+    with pytest.raises(ValueError, match="detail_head_scope"):
+        build_model(ModelConfig(detail_head_scope="sometimes"))
+
+
+def test_unetpp_ensemble_scope_shapes_and_learns(tmp_path):
+    """detail_head_scope='ensemble': supervision heads train unrefined plus
+    ONE refined ensemble output (stacked last); inference returns the
+    refined ensemble.  The refinement compute runs once, not once per head
+    (the -43% round-3 cost)."""
+    from ddlpc_tpu.config import DataConfig, ExperimentConfig, TrainConfig
+    from ddlpc_tpu.train.trainer import Trainer
+
+    mcfg = ModelConfig(
+        name="unetpp", features=(8, 16, 32), num_classes=4,
+        deep_supervision=True, stem="s2d", stem_factor=2,
+        detail_head=True, detail_head_kind="s2d", detail_head_hidden=8,
+        detail_head_scope="ensemble", train_head_layout="grouped",
+        head_dtype="bfloat16",
+    )
+    model = build_model(mcfg)
+    x = jnp.zeros((2, 64, 64, 3))
+    v = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(v, x, train=True, mutable=["batch_stats"])[0]
+    # 2 supervision heads + 1 refined ensemble, grouped layout (32² grid).
+    assert out.shape == (3, 2, 32, 32, 4 * 4)
+    infer = model.apply(v, x, train=False)
+    assert infer.shape == (2, 64, 64, 4)
+
+    cfg = ExperimentConfig(
+        model=mcfg,
+        data=DataConfig(dataset="synthetic", image_size=(64, 64),
+                        synthetic_len=40, test_split=8, num_classes=4),
+        train=TrainConfig(epochs=25, micro_batch_size=1, sync_period=2,
+                          learning_rate=3e-3, dump_images_per_epoch=0,
+                          checkpoint_every_epochs=0),
+        workdir=str(tmp_path),
+    )
+    rec = Trainer(cfg).fit()
+    assert rec["val_miou"] > 0.5
