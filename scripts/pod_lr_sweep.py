@@ -1,0 +1,109 @@
+"""Validate the pod operating points on one chip (VERDICT r3 next #1).
+
+The reference ran its shipped config on its actual cluster — its measured
+configuration IS its shipped configuration (кластер.py:23-25,685-687).
+Round 3's pod configs (v5e-8 / v5e-64) recorded operating points no curve
+backed.  Gradient accumulation ≡ big batch is proven
+(tests/test_train_step.py), so an 8-chip global batch is validatable ON
+ONE CHIP by multiplying sync_period: B_global(8 × micro 128 × sync 4) =
+4096 = one chip at micro 128 × sync 32.
+
+Arms (hard task, 512², fp16 codec — the flagship protocol of
+docs/flagship_recipe/):
+- flagship arch at global super-batch 4096 (the v5e-8 flagship point),
+  LR sweep {2e-3, 4e-3, 8e-3} — linear-scaling heuristic says 8×2e-3
+  would be 1.6e-2; the sweep brackets below it because Adam scales
+  sublinearly;
+- reference-parity arch (stem none, fp32 head, no refinement) at global
+  super-batch 1024 (the v5e-8 ref-parity zoo point), LR {1e-3, 2e-3}.
+
+Step budgets hold the flagship curve's protocol (optimizer steps, not
+epochs — one step consumes the whole wrapped dataset several times over
+at these batches).  Results land next to the flagship curves in
+docs/flagship_recipe/ and back configs/vaihingen_unet_v5e8.json.
+
+Usage: python scripts/pod_lr_sweep.py [--steps 200] [--which flagship,ref]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_SCRIPTS_DIR))
+sys.path.insert(0, _SCRIPTS_DIR)
+
+from convergence_ab import run_variant  # noqa: E402
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=200,
+                   help="optimizer steps per arm (2x the 512-batch curve's "
+                   "tile budget at super-batch 4096)")
+    p.add_argument("--flagship-lrs", default="2e-3,4e-3,8e-3")
+    p.add_argument("--ref-lrs", default="1e-3,2e-3")
+    p.add_argument("--which", default="flagship,ref")
+    p.add_argument("--outdir", default="docs/flagship_recipe")
+    p.add_argument("--detail-kind", default="fullres")
+    p.add_argument("--detail-hidden", type=int, default=16)
+    p.add_argument("--head-layout", default="fullres")
+    args = p.parse_args()
+
+    which = args.which.split(",")
+    results = []
+    if "flagship" in which:
+        for lr in [float(s) for s in args.flagship_lrs.split(",") if s]:
+            tag = f"pod4096_flagship_lr{lr:g}"
+            if args.detail_kind != "fullres":
+                tag += f"_{args.detail_kind}h{args.detail_hidden}"
+            rec = run_variant(
+                tag,
+                4,
+                "float16",
+                epochs=args.steps,
+                outdir=args.outdir,
+                micro_batch=128,
+                sync_period=32,  # 128 × 32 = 4096 = 8 chips × 128 × 4
+                dataset="synthetic_hard",
+                head_dtype="bfloat16",
+                detail_head=True,
+                detail_head_kind=args.detail_kind,
+                detail_head_hidden=args.detail_hidden,
+                train_head_layout=args.head_layout,
+                learning_rate=lr,
+            )
+            results.append(rec)
+            print(json.dumps(rec), flush=True)
+    if "ref" in which:
+        for lr in [float(s) for s in args.ref_lrs.split(",") if s]:
+            rec = run_variant(
+                f"pod1024_refarch_lr{lr:g}",
+                1,  # stem none = reference-parity layout
+                "float16",
+                epochs=args.steps,
+                outdir=args.outdir,
+                micro_batch=16,  # the ref-arch zoo row's HBM-safe B
+                sync_period=64,  # 16 × 64 = 1024 = 8 chips × 16 × 8
+                dataset="synthetic_hard",
+                head_dtype="float32",
+                learning_rate=lr,
+            )
+            results.append(rec)
+            print(json.dumps(rec), flush=True)
+
+    summary_path = os.path.join(args.outdir, "summary.json")
+    merged = {}
+    if os.path.exists(summary_path):
+        with open(summary_path) as f:
+            merged = {r["tag"]: r for r in json.load(f)}
+    merged.update({r["tag"]: r for r in results})
+    with open(summary_path, "w") as f:
+        json.dump(list(merged.values()), f, indent=2)
+
+
+if __name__ == "__main__":
+    main()
