@@ -102,6 +102,26 @@ BENCHES = {
         sync_period=4,
         compression="float16",
     ),
+    # Middle Pareto point from the round-4 refinement sweep
+    # (docs/HARD_TASK.md round-4 table): hidden-32 full-res DetailHead,
+    # hard-task 0.9125 @120 epochs vs the flagship h16's 0.897, at −14%
+    # throughput (docs/head_bench/results.json rows fullres_h32 1458 vs
+    # fullres_h16 1693).
+    "unet_vaihingen512_detail32": dict(
+        model=dict(
+            width_divisor=2,
+            num_classes=6,
+            stem="s2d",
+            stem_factor=4,
+            detail_head=True,
+            detail_head_hidden=32,
+            head_dtype="bfloat16",
+        ),
+        image=(512, 512),
+        micro_batch=128,
+        sync_period=4,
+        compression="float16",
+    ),
     # Quality-first zoo row (docs/HARD_TASK.md): s2d×2 + DetailHead
     # converges to 0.956 on the hard task (vs full-res 0.991 at the same
     # 120-epoch budget; flagship 0.897) at 1.6× the 400 target.

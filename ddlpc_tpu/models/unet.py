@@ -70,6 +70,19 @@ class UNet(nn.Module):
         # s2d: run the whole pyramid at 1/r resolution on r²-richer
         # channels; logits return to full resolution via a subpixel head.
         x = apply_stem(x, self.stem, self.stem_factor)
+        min_px = 2 ** len(self.features)
+        if x.shape[1] < min_px or x.shape[2] < min_px:
+            # A too-shallow input silently pools to a ZERO-size tensor at
+            # the deepest level, and BatchNorm over 0 elements is NaN that
+            # the codec's global max-abs then spreads to every gradient —
+            # fail loudly instead (found the hard way on a 64² smoke run).
+            raise ValueError(
+                f"input {image.shape[1:3]} too small for a "
+                f"{len(self.features)}-level pyramid behind the "
+                f"{self.stem!r} stem (grid {x.shape[1:3]} after the stem; "
+                f"the deepest pool needs ≥ {min_px} px) — use a larger "
+                f"tile, fewer features, or a smaller stem_factor"
+            )
         common = dict(
             norm=self.norm,
             norm_axis_name=self.norm_axis_name,

@@ -87,6 +87,15 @@ class UNetPP(nn.Module):
         image = x  # raw full-res input for the optional DetailHead
         x = apply_stem(x, self.stem, self.stem_factor)
         depth = len(self.features)
+        min_px = 2 ** (depth - 1)
+        if x.shape[1] < min_px or x.shape[2] < min_px:
+            # Same zero-size-pool NaN hazard as UNet (see unet.py).
+            raise ValueError(
+                f"input {image.shape[1:3]} too small for a {depth}-level "
+                f"U-Net++ grid behind the {self.stem!r} stem (grid "
+                f"{x.shape[1:3]} after the stem; the deepest pool needs "
+                f"≥ {min_px} px)"
+            )
         common = dict(
             norm=self.norm,
             norm_axis_name=self.norm_axis_name,

@@ -74,6 +74,7 @@ def run_variant(
     model_name: str = "unet",
     deep_supervision: bool = False,
     detail_head_scope: str = "per_head",
+    compact_batch: bool = False,
 ) -> dict:
     cfg = ExperimentConfig(
         model=ModelConfig(
@@ -116,9 +117,18 @@ def run_variant(
         SYNTHETIC_GENERATORS[dataset](num_tiles, image_size, seed=1), test_split
     )
     repl = NamedSharding(mesh, P())
-    # One upload; every batch is an on-device gather.
-    tr_x = jax.device_put(train_ds.images, repl)
-    tr_y = jax.device_put(train_ds.labels, repl)
+    # One upload; every batch is an on-device gather.  compact_batch (pod-
+    # scale emulation, scripts/pod_lr_sweep.py): store/gather images as
+    # bfloat16 and labels as int8 — numerically IDENTICAL training (the
+    # model's first op casts inputs to its bf16 compute dtype anyway, and
+    # labels only feed integer compare/one-hot ops), at 40% of the HBM a
+    # super-batch of thousands of fp32 512² tiles would need.
+    img_dt = jnp.bfloat16 if compact_batch else jnp.float32
+    lab_dt = jnp.int8 if compact_batch else jnp.int32
+    if compact_batch and cfg.model.num_classes > 127:
+        raise ValueError("compact_batch int8 labels need num_classes <= 127")
+    tr_x = jax.device_put(train_ds.images.astype(img_dt, copy=False), repl)
+    tr_y = jax.device_put(train_ds.labels.astype(lab_dt, copy=False), repl)
     B = micro_batch * n_dev
     A = sync_period
     super_batch = B * A
@@ -194,6 +204,20 @@ def run_variant(
             log.write(json.dumps(rec) + "\n")
             log.flush()
     return rec
+
+
+def merge_summary(outdir: str, results: "list[dict]") -> None:
+    """Merge rows into {outdir}/summary.json by tag: partial reruns of one
+    study must never delete another study's committed headline entries.
+    Shared by every sweep driver in scripts/."""
+    summary_path = os.path.join(outdir, "summary.json")
+    merged = {}
+    if os.path.exists(summary_path):
+        with open(summary_path) as f:
+            merged = {r["tag"]: r for r in json.load(f)}
+    merged.update({r["tag"]: r for r in results})
+    with open(summary_path, "w") as f:
+        json.dump(list(merged.values()), f, indent=2)
 
 
 def main() -> None:
@@ -310,16 +334,7 @@ def main() -> None:
             )
         results.append(rec)
         print(json.dumps(results[-1]))
-    # Merge by tag into any existing summary: partial reruns (one study)
-    # must not delete the other studies' committed headline entries.
-    summary_path = os.path.join(args.outdir, "summary.json")
-    merged = {}
-    if os.path.exists(summary_path):
-        with open(summary_path) as f:
-            merged = {r["tag"]: r for r in json.load(f)}
-    merged.update({r["tag"]: r for r in results})
-    with open(summary_path, "w") as f:
-        json.dump(list(merged.values()), f, indent=2)
+    merge_summary(args.outdir, results)
 
 
 if __name__ == "__main__":

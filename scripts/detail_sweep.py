@@ -28,7 +28,7 @@ _SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_SCRIPTS_DIR))
 sys.path.insert(0, _SCRIPTS_DIR)
 
-from convergence_ab import run_variant  # noqa: E402
+from convergence_ab import merge_summary, run_variant  # noqa: E402
 
 ARMS = {
     # r3 design, more capacity.
@@ -76,14 +76,7 @@ def main() -> None:
         results.append(rec)
         print(json.dumps(rec), flush=True)
 
-    summary_path = os.path.join(args.outdir, "summary.json")
-    merged = {}
-    if os.path.exists(summary_path):
-        with open(summary_path) as f:
-            merged = {r["tag"]: r for r in json.load(f)}
-    merged.update({r["tag"]: r for r in results})
-    with open(summary_path, "w") as f:
-        json.dump(list(merged.values()), f, indent=2)
+    merge_summary(args.outdir, results)
 
 
 if __name__ == "__main__":

@@ -143,8 +143,10 @@ def main() -> None:
         rec = dict(bench.run_bench(tag, args.rounds), tag=tag)
         results[tag] = rec
         print(json.dumps(rec), flush=True)
-    with open(out_path, "w") as f:
-        json.dump(list(results.values()), f, indent=2)
+        # Write after EVERY candidate: a hung arm (the s2d_h64_fullres HBM
+        # hang) must not lose the finished rows.
+        with open(out_path, "w") as f:
+            json.dump(list(results.values()), f, indent=2)
 
 
 if __name__ == "__main__":

@@ -36,7 +36,7 @@ _SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_SCRIPTS_DIR))
 sys.path.insert(0, _SCRIPTS_DIR)
 
-from convergence_ab import run_variant  # noqa: E402
+from convergence_ab import merge_summary, run_variant  # noqa: E402
 
 
 def main() -> None:
@@ -66,8 +66,14 @@ def main() -> None:
                 "float16",
                 epochs=args.steps,
                 outdir=args.outdir,
-                micro_batch=128,
-                sync_period=32,  # 128 × 32 = 4096 = 8 chips × 128 × 4
+                # Same GLOBAL batch as 8 chips × micro 128 × sync 4; the
+                # micro split is 64×64 (accumulation ≡ big batch is proven,
+                # tests/test_train_step.py) and the feed is compact
+                # (bf16 images / int8 labels — numerically identical, fits
+                # a 4096-tile super-batch in HBM).
+                micro_batch=64,
+                sync_period=64,
+                compact_batch=True,
                 dataset="synthetic_hard",
                 head_dtype="bfloat16",
                 detail_head=True,
@@ -95,14 +101,7 @@ def main() -> None:
             results.append(rec)
             print(json.dumps(rec), flush=True)
 
-    summary_path = os.path.join(args.outdir, "summary.json")
-    merged = {}
-    if os.path.exists(summary_path):
-        with open(summary_path) as f:
-            merged = {r["tag"]: r for r in json.load(f)}
-    merged.update({r["tag"]: r for r in results})
-    with open(summary_path, "w") as f:
-        json.dump(list(merged.values()), f, indent=2)
+    merge_summary(args.outdir, results)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,128 @@
+"""Capture + aggregate an xplane trace of a head_bench candidate's step.
+
+Round-4 use: the r3 roofline attributed 0.13 s of the flagship step to the
+"head region", but the candidate grid (docs/head_bench/results.json)
+showed removing the refinement entirely only buys 17.5 ms — so ~0.11 s of
+the NO-refinement step is non-conv floor the roofline never attributed.
+This script traces a candidate end to end and writes the top self-time
+ops, so the floor is itemized instead of guessed.
+
+Usage: python scripts/trace_step.py [--tag plain_grouped] [--top 30]
+Writes docs/head_bench/trace_<tag>.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+_SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_SCRIPTS_DIR))
+sys.path.insert(0, _SCRIPTS_DIR)
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import bench  # noqa: E402
+from head_bench import CANDIDATES  # noqa: E402
+from xplane_top import self_times  # noqa: E402
+
+from ddlpc_tpu.config import (  # noqa: E402
+    CompressionConfig,
+    DataConfig,
+    ExperimentConfig,
+    ModelConfig,
+    ParallelConfig,
+    TrainConfig,
+)
+from ddlpc_tpu.models import build_model_from_experiment  # noqa: E402
+from ddlpc_tpu.parallel.mesh import make_mesh  # noqa: E402
+from ddlpc_tpu.parallel.train_step import (  # noqa: E402
+    create_train_state,
+    make_train_step,
+)
+from ddlpc_tpu.train.optim import build_optimizer  # noqa: E402
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--tag", default="plain_grouped")
+    p.add_argument("--top", type=int, default=30)
+    p.add_argument("--steps", type=int, default=2)
+    p.add_argument("--outdir", default="docs/head_bench")
+    args = p.parse_args()
+
+    spec = CANDIDATES[args.tag]
+    h, w = spec["image"]
+    cfg = ExperimentConfig(
+        model=ModelConfig(**spec["model"]),
+        data=DataConfig(image_size=(h, w)),
+        train=TrainConfig(
+            micro_batch_size=spec["micro_batch"], sync_period=spec["sync_period"]
+        ),
+        parallel=ParallelConfig(),
+        compression=CompressionConfig(mode=spec["compression"]),
+    )
+    mesh = make_mesh(cfg.parallel)
+    model = build_model_from_experiment(cfg)
+    tx = build_optimizer(cfg.train)
+    state = create_train_state(model, tx, jax.random.key(0), (1, h, w, 3))
+    step = make_train_step(model, tx, mesh, cfg.compression)
+    rng = np.random.default_rng(0)
+    A, B = spec["sync_period"], spec["micro_batch"]
+    images = jax.device_put(
+        rng.uniform(0, 1, (A, B, h, w, 3)).astype(np.float32),
+        NamedSharding(mesh, P(None, "data")),
+    )
+    labels = jax.device_put(
+        rng.integers(0, cfg.model.num_classes, (A, B, h, w)).astype(np.int32),
+        NamedSharding(mesh, P(None, "data")),
+    )
+    compiled = step.lower(state, images, labels).compile()
+    for _ in range(3):  # warm past program upload
+        state, m = compiled(state, images, labels)
+        float(m["loss"])
+    trace_dir = tempfile.mkdtemp(prefix=f"trace_{args.tag}_")
+    with jax.profiler.trace(trace_dir):
+        for _ in range(args.steps):
+            state, m = compiled(state, images, labels)
+        float(m["loss"])
+    # self_times yields (plane, Counter[name -> self ps], Counter[name -> n])
+    # per device plane; merge (single-chip here).
+    agg, cnt = None, None
+    for _plane, a, c in self_times(trace_dir):
+        if agg is None:
+            agg, cnt = a, c
+        else:
+            agg.update(a)
+            cnt.update(c)
+    assert agg is not None, "no device plane in trace"
+    total_ps = sum(agg.values())
+    out = {
+        "tag": args.tag,
+        "steps_traced": args.steps,
+        "device_total_ms": round(total_ps / 1e9, 2),
+        "per_step_ms": round(total_ps / 1e9 / args.steps, 2),
+        "top_self_time": [
+            {
+                "op": name[:120],
+                "self_ms_per_step": round(ps / 1e9 / args.steps, 3),
+                "count": cnt[name],
+            }
+            for name, ps in agg.most_common(args.top)
+        ],
+    }
+    os.makedirs(args.outdir, exist_ok=True)
+    path = os.path.join(args.outdir, f"trace_{args.tag}.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out["top_self_time"][:12], indent=1))
+    print("->", path)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""U-Net++ refinement-scope quality A/B (VERDICT r3 weak #3 tail).
+
+Round 3 shipped the shared DetailHead refining EVERY supervision head
+(−43% throughput, compute × (depth−1)) with no alternative tried.  Round 4
+adds `detail_head_scope='ensemble'` (one refinement pass on the ensemble
+readout, supervised directly).  This runs both scopes on the hard task at
+the r3 120-epoch protocol, same U-Net++ geometry, so quality lands next to
+the throughput A/B (scripts/zoo_variants_bench.py).
+
+Usage: python scripts/unetpp_scope_ab.py [--epochs 120]
+Writes into docs/convergence_ab_hard120/ (tags unetpp_scope_*).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_SCRIPTS_DIR))
+sys.path.insert(0, _SCRIPTS_DIR)
+
+from convergence_ab import merge_summary, run_variant  # noqa: E402
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=120)
+    p.add_argument("--outdir", default="docs/convergence_ab_hard120")
+    args = p.parse_args()
+
+    results = []
+    for scope in ("per_head", "ensemble"):
+        rec = run_variant(
+            f"unetpp_scope_{scope}_hard",
+            4,
+            "float16",
+            args.epochs,
+            args.outdir,
+            dataset="synthetic_hard",
+            model_name="unetpp",
+            deep_supervision=True,
+            detail_head=True,
+            detail_head_scope=scope,
+            head_dtype="bfloat16",
+        )
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    merge_summary(args.outdir, results)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""Throughput of round-4 zoo-row variants (grouped layout / ensemble scope).
+
+The grouped train layout is math-identical (tests prove it), so any plain
+s2d zoo row can adopt it if it measures faster.  The U-Net++ ensemble
+refinement scope is the candidate fix for the r3 −43% per-head refinement
+cost.  This measures, through bench.py's pipelined harness:
+
+- unet_cityscapes512x1024 with train_head_layout='grouped' (19-class ×16
+  subpixel head: the largest logit tensor in the zoo);
+- unetpp_vaihingen512_s2d with grouped layout;
+- unetpp_vaihingen512_s2d + shared DetailHead, per_head (r3: 383) vs
+  ensemble scope, grouped.
+
+Writes/merges docs/head_bench/zoo_variants.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_SCRIPTS_DIR))
+
+import bench  # noqa: E402
+
+VARIANTS = {
+    "cityscapes_grouped": dict(
+        model=dict(
+            width_divisor=1, num_classes=19, stem="s2d", stem_factor=4,
+            head_dtype="bfloat16", train_head_layout="grouped",
+        ),
+        image=(512, 1024),
+        micro_batch=32,
+        sync_period=4,
+        compression="float16",
+    ),
+    "unetpp_s2d_grouped": dict(
+        model=dict(
+            name="unetpp", width_divisor=1, num_classes=6,
+            features=(32, 64, 128, 256, 512), deep_supervision=True,
+            stem="s2d", stem_factor=4, head_dtype="bfloat16",
+            train_head_layout="grouped",
+        ),
+        image=(512, 512),
+        micro_batch=96,
+        sync_period=4,
+        compression="none",
+    ),
+    "unetpp_s2d_detail_perhead": dict(
+        model=dict(
+            name="unetpp", width_divisor=1, num_classes=6,
+            features=(32, 64, 128, 256, 512), deep_supervision=True,
+            stem="s2d", stem_factor=4, head_dtype="bfloat16",
+            detail_head=True,
+        ),
+        image=(512, 512),
+        micro_batch=96,
+        sync_period=4,
+        compression="none",
+    ),
+    "unetpp_s2d_detail_ensemble": dict(
+        model=dict(
+            name="unetpp", width_divisor=1, num_classes=6,
+            features=(32, 64, 128, 256, 512), deep_supervision=True,
+            stem="s2d", stem_factor=4, head_dtype="bfloat16",
+            detail_head=True, detail_head_scope="ensemble",
+        ),
+        image=(512, 512),
+        micro_batch=96,
+        sync_period=4,
+        compression="none",
+    ),
+}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--rounds", type=int, default=3)
+    p.add_argument("--only", default="")
+    p.add_argument("--outdir", default="docs/head_bench")
+    args = p.parse_args()
+
+    tags = [t for t in args.only.split(",") if t] or list(VARIANTS)
+    os.makedirs(args.outdir, exist_ok=True)
+    out_path = os.path.join(args.outdir, "zoo_variants.json")
+    results = {}
+    if os.path.exists(out_path):
+        results = {r["tag"]: r for r in json.load(open(out_path))}
+    for tag in tags:
+        bench.BENCHES[tag] = VARIANTS[tag]
+        rec = dict(bench.run_bench(tag, args.rounds), tag=tag)
+        results[tag] = rec
+        print(json.dumps(rec), flush=True)
+        # Write after every row (see head_bench.py: a hung arm must not
+        # lose finished results).
+        with open(out_path, "w") as f:
+            json.dump(list(results.values()), f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -533,3 +533,20 @@ def test_unetpp_ensemble_scope_shapes_and_learns(tmp_path):
     )
     rec = Trainer(cfg).fit()
     assert rec["val_miou"] > 0.5
+
+
+def test_pyramid_too_shallow_raises():
+    """A tile that pools to a zero-size tensor at the deepest level must
+    raise at trace time, not silently produce NaN BatchNorm gradients that
+    the codec's global max-abs spreads through the whole tree (found on a
+    64² smoke run of the s2d×4 flagship geometry)."""
+    cfg = ModelConfig(width_divisor=2, num_classes=6, stem="s2d", stem_factor=4)
+    model = build_model(cfg)
+    with pytest.raises(ValueError, match="too small"):
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)), train=False)
+    cfg = ModelConfig(name="unetpp", features=(8, 16, 32), num_classes=6,
+                      stem="s2d", stem_factor=4)
+    with pytest.raises(ValueError, match="too small"):
+        build_model(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3)), train=False
+        )
