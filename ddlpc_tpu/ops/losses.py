@@ -34,6 +34,52 @@ def _per_pixel_nll(
     return nll
 
 
+def nll_correct_valid(
+    logits: jax.Array,
+    labels: jax.Array,
+    ignore_index: Optional[int] = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One fused pass over the logits: per-pixel (NLL, tie-corrected
+    correctness, validity) — the train step's loss AND accuracy inputs.
+
+    Motivation is measured, not stylistic (docs/head_bench/
+    trace_plain_grouped.json): computing loss and accuracy separately via
+    an up-front ``logits.astype(float32)`` materialized a full fp32 copy
+    of the largest tensor in the step plus four ~11.5 ms layout-transposed
+    intermediates — ~90 ms of a 273 ms step.  Here the bf16 logits are
+    read once; every fp32 cast happens inside the elementwise chain (in
+    registers), the row max is shared between logsumexp and the
+    correctness compare, and nothing class-shaped is materialized in fp32.
+
+    Numerics: identical to the separate paths up to fp reassociation —
+    ``logsumexp(f32(l)) == f32(m) + log Σ exp(f32(l) − f32(m))`` with m
+    the row max, and the tie semantics are unchanged (bf16 values compare
+    equal iff their f32 casts do).  Guarded by
+    tests/test_metrics.py::test_fused_nll_matches_separate_paths.
+    """
+    num_classes = logits.shape[-1]
+    labels_clipped = jnp.clip(labels, 0, num_classes - 1).astype(jnp.int32)
+    onehot = labels_clipped[..., None] == jnp.arange(num_classes, dtype=jnp.int32)
+    m = logits.max(axis=-1)
+    zf = logits.astype(jnp.float32) - m.astype(jnp.float32)[..., None]
+    lse = m.astype(jnp.float32) + jnp.log(jnp.sum(jnp.exp(zf), axis=-1))
+    picked = jnp.sum(jnp.where(onehot, zf, 0.0), axis=-1)  # = logit − max
+    nll = lse - m.astype(jnp.float32) - picked
+    # Tie-corrected correctness (ops/metrics.py:pixel_accuracy semantics):
+    # a pixel counts 1/#tied iff its label's logit equals the row max.
+    is_max = (logits == m[..., None])
+    ties = jnp.sum(is_max.astype(jnp.float32), axis=-1)
+    label_is_max = jnp.sum(
+        jnp.where(onehot & is_max, 1.0, 0.0), axis=-1
+    )
+    correct = label_is_max / jnp.maximum(ties, 1.0)
+    if ignore_index is None:
+        valid = jnp.ones(nll.shape, jnp.float32)
+    else:
+        valid = (labels != ignore_index).astype(jnp.float32)
+    return nll, correct, valid
+
+
 def softmax_cross_entropy_sum(
     logits: jax.Array,
     labels: jax.Array,

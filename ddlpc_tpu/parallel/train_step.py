@@ -34,8 +34,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ddlpc_tpu.config import CompressionConfig, ExperimentConfig
 from ddlpc_tpu.models.layers import group_labels
-from ddlpc_tpu.ops.losses import softmax_cross_entropy, softmax_cross_entropy_sum
-from ddlpc_tpu.ops.metrics import confusion_from_logits, pixel_accuracy
+from ddlpc_tpu.ops.losses import nll_correct_valid, softmax_cross_entropy_sum
+from ddlpc_tpu.ops.metrics import confusion_from_logits
 from ddlpc_tpu.parallel.grad_sync import sync_gradients
 
 PyTree = Any
@@ -123,8 +123,23 @@ def _loss_and_metrics(
     # deliberately the torch CrossEntropyLoss(reduction='mean') + DDP
     # semantics the reference inherits, not a globally pixel-weighted mean;
     # the eval path (softmax_cross_entropy_sum) is globally weighted.
-    loss = softmax_cross_entropy(logits, labels, ignore_index=-1)
-    acc = pixel_accuracy(logits, labels, ignore_index=-1)
+    # Loss and accuracy come from ONE fused pass over the logits
+    # (ops/losses.py:nll_correct_valid) — computing them separately cost
+    # ~90 ms/step in fp32 materializations and layout copies of the
+    # largest tensor in the step (docs/head_bench/trace_plain_grouped.json).
+    nll, correct, valid = nll_correct_valid(logits, labels, ignore_index=-1)
+    # Deep-supervision stacks ([J, ...] logits with labels broadcast over
+    # J): broadcasting valid to nll's shape makes the denominator count
+    # head×pixel terms, so the loss is the MEAN of per-head losses (the
+    # documented U-Net++ semantics) and accuracy stays in [0, 1].  The
+    # previous sum/valid.sum() form counted pixels once — J× the per-head
+    # mean and >1 accuracies (review find, round 4; Adam's update is
+    # invariant to the loss scale, so committed r3 U-Net++ curves remain
+    # valid trajectories — only the reported loss/acc change).
+    valid = jnp.broadcast_to(valid, nll.shape)
+    denom = jnp.maximum(valid.sum(), 1.0)
+    loss = (nll * valid).sum() / denom
+    acc = (correct * valid).sum() / denom
     return loss, (new_stats, acc)
 
 

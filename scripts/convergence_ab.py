@@ -194,6 +194,11 @@ def run_variant(
                 bx, by = gather_batch(tr_x, tr_y, idx)
                 state, m = step(state, bx, by)
                 losses.append(m["loss"])
+                # Free the device super-batch as soon as the step consumed
+                # it: holding the python refs across iterations keeps TWO
+                # super-batches alive, which at pod-emulation sizes (4096 ×
+                # 512² bf16 ≈ 6.4 GB each) RESOURCE_EXHAUSTs the chip.
+                del bx, by
             rec = {
                 "tag": tag,
                 "epoch": epoch,
@@ -206,11 +211,14 @@ def run_variant(
     return rec
 
 
-def merge_summary(outdir: str, results: "list[dict]") -> None:
-    """Merge rows into {outdir}/summary.json by tag: partial reruns of one
+def merge_summary(
+    outdir: str, results: "list[dict]", filename: str = "summary.json"
+) -> None:
+    """Merge rows into {outdir}/{filename} by tag: partial reruns of one
     study must never delete another study's committed headline entries.
-    Shared by every sweep driver in scripts/."""
-    summary_path = os.path.join(outdir, "summary.json")
+    Shared by the convergence-style sweep drivers in scripts/ (the bench
+    drivers keep their own incremental per-row writes)."""
+    summary_path = os.path.join(outdir, filename)
     merged = {}
     if os.path.exists(summary_path):
         with open(summary_path) as f:

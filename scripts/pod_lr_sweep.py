@@ -67,12 +67,13 @@ def main() -> None:
                 epochs=args.steps,
                 outdir=args.outdir,
                 # Same GLOBAL batch as 8 chips × micro 128 × sync 4; the
-                # micro split is 64×64 (accumulation ≡ big batch is proven,
-                # tests/test_train_step.py) and the feed is compact
-                # (bf16 images / int8 labels — numerically identical, fits
-                # a 4096-tile super-batch in HBM).
-                micro_batch=64,
-                sync_period=64,
+                # micro split is 32×128 (accumulation ≡ big batch is proven,
+                # tests/test_train_step.py — micro 64 RESOURCE_EXHAUSTed
+                # next to the 6.4 GB resident super-batch) and the feed is
+                # compact (bf16 images / int8 labels — numerically
+                # identical, fits a 4096-tile super-batch in HBM).
+                micro_batch=32,
+                sync_period=128,
                 compact_batch=True,
                 dataset="synthetic_hard",
                 head_dtype="bfloat16",

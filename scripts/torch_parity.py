@@ -195,34 +195,65 @@ def main() -> None:
         help="synthetic_hard = non-saturating task (converged mIoU < 1.0, "
         "so parity is measured where the metric discriminates)",
     )
+    p.add_argument(
+        "--arms",
+        default="torch,jax",
+        help="which sides to run this invocation; results merge into --out "
+        "by (seed, side), so the ~hours torch CPU arm and the accelerator "
+        "jax arm can run at different times without contending for the one "
+        "host core / the one chip (512² round-4 protocol)",
+    )
     args = p.parse_args()
 
+    arms = args.arms.split(",")
     train_ds, test_ds = make_data(args.size, dataset=args.dataset)
-    rows = []
-    for seed in [int(s) for s in args.seeds.split(",")]:
-        t = run_torch(train_ds, test_ds, args.epochs, args.batch, args.lr, seed)
-        j = run_jax(
-            args.size, args.epochs, args.batch, args.lr, seed,
-            workdir=f"/tmp/parity_jax_{args.dataset}_{seed}",
-            dataset=args.dataset,
-        )
-        rows.append({"seed": seed, "torch_miou": round(t, 4), "jax_miou": round(j, 4)})
-        print(json.dumps(rows[-1]))
-    tm = float(np.mean([r["torch_miou"] for r in rows]))
-    jm = float(np.mean([r["jax_miou"] for r in rows]))
-    summary = {
-        "config": {
-            "arch": "reference-parity half-width U-Net (conv_transpose, BN)",
-            "data": f"{args.dataset} {args.size}^2, 97 train / 30 test",
-            "epochs": args.epochs,
-            "batch": args.batch,
-            "lr": args.lr,
-        },
-        "runs": rows,
-        "torch_mean_miou": round(tm, 4),
-        "jax_mean_miou": round(jm, 4),
-        "delta": round(jm - tm, 4),
+    config = {
+        "arch": "reference-parity half-width U-Net (conv_transpose, BN)",
+        "data": f"{args.dataset} {args.size}^2, 97 train / 30 test",
+        "epochs": args.epochs,
+        "batch": args.batch,
+        "lr": args.lr,
     }
+    # Merge with any existing partial summary (torch-only / jax-only runs)
+    # — but ONLY if it was produced under the same protocol: pairing a
+    # torch mIoU from one (dataset, size, epochs) with a jax mIoU from
+    # another would report a meaningless delta.
+    rows_by_seed: dict[int, dict] = {}
+    if os.path.exists(args.out):
+        with open(args.out) as f:
+            prev = json.load(f)
+        if prev.get("config") == config:
+            for r in prev.get("runs", []):
+                rows_by_seed[int(r["seed"])] = r
+        else:
+            print(
+                f"existing {args.out} was a different protocol "
+                f"({prev.get('config')}); starting fresh", file=sys.stderr
+            )
+    for seed in [int(s) for s in args.seeds.split(",")]:
+        row = rows_by_seed.setdefault(seed, {"seed": seed})
+        if "torch" in arms:
+            t = run_torch(train_ds, test_ds, args.epochs, args.batch, args.lr, seed)
+            row["torch_miou"] = round(t, 4)
+        if "jax" in arms:
+            j = run_jax(
+                args.size, args.epochs, args.batch, args.lr, seed,
+                workdir=f"/tmp/parity_jax_{args.dataset}_{args.size}_{seed}",
+                dataset=args.dataset,
+            )
+            row["jax_miou"] = round(j, 4)
+        print(json.dumps(row))
+    rows = [rows_by_seed[k] for k in sorted(rows_by_seed)]
+    done = [r for r in rows if "torch_miou" in r and "jax_miou" in r]
+    summary = {"config": config, "runs": rows}
+    if done:
+        tm = float(np.mean([r["torch_miou"] for r in done]))
+        jm = float(np.mean([r["jax_miou"] for r in done]))
+        summary.update(
+            torch_mean_miou=round(tm, 4),
+            jax_mean_miou=round(jm, 4),
+            delta=round(jm - tm, 4),
+        )
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(summary, f, indent=2)

@@ -27,7 +27,6 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-import bench  # noqa: E402
 from head_bench import CANDIDATES  # noqa: E402
 from xplane_top import self_times  # noqa: E402
 

@@ -71,3 +71,37 @@ def test_cross_entropy_matches_manual():
     np.testing.assert_allclose(
         float(softmax_cross_entropy(logits, labels)), -np.log(p[2]), rtol=1e-6
     )
+
+
+def test_fused_nll_matches_separate_paths():
+    """nll_correct_valid (the train step's single fused pass) must agree
+    with the separately-computed softmax_cross_entropy and pixel_accuracy
+    to fp reassociation, including bf16 ties and void pixels."""
+    import numpy as np
+
+    from ddlpc_tpu.ops.losses import nll_correct_valid, softmax_cross_entropy
+    from ddlpc_tpu.ops.metrics import pixel_accuracy
+
+    rng = np.random.default_rng(0)
+    for dtype in (jnp.float32, jnp.bfloat16):
+        logits = jnp.asarray(
+            rng.normal(size=(3, 8, 8, 6)) * 2, jnp.float32
+        ).astype(dtype)
+        labels = jnp.asarray(rng.integers(-1, 6, (3, 8, 8)), jnp.int32)
+        nll, correct, valid = nll_correct_valid(logits, labels, ignore_index=-1)
+        denom = max(float(valid.sum()), 1.0)
+        loss_fused = float((nll * valid).sum() / denom)
+        acc_fused = float((correct * valid).sum() / denom)
+        loss_ref = float(softmax_cross_entropy(logits, labels, ignore_index=-1))
+        acc_ref = float(pixel_accuracy(logits, labels, ignore_index=-1))
+        assert np.isclose(loss_fused, loss_ref, rtol=1e-5, atol=1e-6), (
+            dtype, loss_fused, loss_ref
+        )
+        assert np.isclose(acc_fused, acc_ref, rtol=1e-6), (
+            dtype, acc_fused, acc_ref
+        )
+    # Degenerate: everything void.
+    nll, correct, valid = nll_correct_valid(
+        jnp.zeros((2, 4, 4, 3)), jnp.full((2, 4, 4), -1), ignore_index=-1
+    )
+    assert float(valid.sum()) == 0.0
