@@ -75,11 +75,12 @@ def run_variant(
     deep_supervision: bool = False,
     detail_head_scope: str = "per_head",
     compact_batch: bool = False,
+    width_divisor: int = 2,
 ) -> dict:
     cfg = ExperimentConfig(
         model=ModelConfig(
             name=model_name,
-            width_divisor=2,
+            width_divisor=width_divisor,
             num_classes=6,
             stem="s2d" if stem_factor > 1 else "none",
             stem_factor=max(stem_factor, 2),

@@ -5,15 +5,21 @@ configuration IS its shipped configuration (кластер.py:23-25,685-687).
 Round 3's pod configs (v5e-8 / v5e-64) recorded operating points no curve
 backed.  Gradient accumulation ≡ big batch is proven
 (tests/test_train_step.py), so an 8-chip global batch is validatable ON
-ONE CHIP by multiplying sync_period: B_global(8 × micro 128 × sync 4) =
-4096 = one chip at micro 128 × sync 32.
+ONE CHIP by multiplying sync_period: B_global(8 chips × micro 128 ×
+sync 1) = 1024 = one chip at micro 128 × sync 8.
 
 Arms (hard task, 512², fp16 codec — the flagship protocol of
 docs/flagship_recipe/):
-- flagship arch at global super-batch 4096 (the v5e-8 flagship point),
-  LR sweep {2e-3, 4e-3, 8e-3} — linear-scaling heuristic says 8×2e-3
-  would be 1.6e-2; the sweep brackets below it because Adam scales
-  sublinearly;
+- flagship arch at global super-batch 1024 — the v5e-8 operating point is
+  micro 128/chip × sync_period 1 × 8 chips: on ICI the all-reduce is
+  ~free, so accumulation (which exists for slow links, the reference's
+  LAN) is pointless and global batch stays in a validated regime.  The
+  4096 point (micro 128 × sync 4 × 8) was attempted and twice
+  RESOURCE_EXHAUSTED/hung the chip during one-chip emulation (a 6.4 GB
+  resident super-batch leaves no headroom at B≥64 micro splits); since
+  no shipped config claims 4096 after the v5e-8 rewrite, the validated
+  point IS the shipped point.  LR sweep {2e-3, 3e-3, 4e-3} brackets
+  sqrt-scaling from the 512-batch curve's 2e-3.
 - reference-parity arch (stem none, fp32 head, no refinement) at global
   super-batch 1024 (the v5e-8 ref-parity zoo point), LR {1e-3, 2e-3}.
 
@@ -41,12 +47,13 @@ from convergence_ab import merge_summary, run_variant  # noqa: E402
 
 def main() -> None:
     p = argparse.ArgumentParser()
-    p.add_argument("--steps", type=int, default=200,
-                   help="optimizer steps per arm (2x the 512-batch curve's "
-                   "tile budget at super-batch 4096)")
-    p.add_argument("--flagship-lrs", default="2e-3,4e-3,8e-3")
+    p.add_argument("--steps", type=int, default=300,
+                   help="optimizer steps per arm (1.5x the 512-batch "
+                   "curve's tile budget at super-batch 1024)")
+    p.add_argument("--flagship-lrs", default="2e-3,3e-3,4e-3")
     p.add_argument("--ref-lrs", default="1e-3,2e-3")
-    p.add_argument("--which", default="flagship,ref")
+    p.add_argument("--cityscapes-lrs", default="1e-3,2e-3")
+    p.add_argument("--which", default="flagship,ref,cityscapes")
     p.add_argument("--outdir", default="docs/flagship_recipe")
     p.add_argument("--detail-kind", default="fullres")
     p.add_argument("--detail-hidden", type=int, default=16)
@@ -57,7 +64,7 @@ def main() -> None:
     results = []
     if "flagship" in which:
         for lr in [float(s) for s in args.flagship_lrs.split(",") if s]:
-            tag = f"pod4096_flagship_lr{lr:g}"
+            tag = f"pod1024_flagship_lr{lr:g}"
             if args.detail_kind != "fullres":
                 tag += f"_{args.detail_kind}h{args.detail_hidden}"
             rec = run_variant(
@@ -66,14 +73,12 @@ def main() -> None:
                 "float16",
                 epochs=args.steps,
                 outdir=args.outdir,
-                # Same GLOBAL batch as 8 chips × micro 128 × sync 4; the
-                # micro split is 32×128 (accumulation ≡ big batch is proven,
-                # tests/test_train_step.py — micro 64 RESOURCE_EXHAUSTed
-                # next to the 6.4 GB resident super-batch) and the feed is
-                # compact (bf16 images / int8 labels — numerically
-                # identical, fits a 4096-tile super-batch in HBM).
-                micro_batch=32,
-                sync_period=128,
+                # Same GLOBAL batch as 8 chips × micro 128 × sync 1
+                # (accumulation ≡ big batch, tests/test_train_step.py);
+                # the compact feed keeps the resident 1024-tile
+                # super-batch at 1.6 GB.
+                micro_batch=128,
+                sync_period=8,
                 compact_batch=True,
                 dataset="synthetic_hard",
                 head_dtype="bfloat16",
@@ -97,6 +102,31 @@ def main() -> None:
                 sync_period=64,  # 16 × 64 = 1024 = 8 chips × 16 × 8
                 dataset="synthetic_hard",
                 head_dtype="float32",
+                learning_rate=lr,
+            )
+            results.append(rec)
+            print(json.dumps(rec), flush=True)
+    if "cityscapes" in which:
+        # The v5e-64 row's architecture (benched: s2d×4, full width, bf16
+        # head, no refinement) at its geometry (512×1024) and its global
+        # batch: micro 16/chip × sync 1 × 64 chips = 1024, emulated as
+        # micro 16 × sync 64 with the compact feed (3.2 GB resident).
+        # The hard task carries 6 structural classes, not Cityscapes' 19 —
+        # geometry-faithful, class-count proxy; stated in the config notes.
+        for lr in [float(s) for s in args.cityscapes_lrs.split(",") if s]:
+            rec = run_variant(
+                f"pod1024_cityscapes_lr{lr:g}",
+                4,
+                "float16",
+                epochs=args.steps,
+                outdir=args.outdir,
+                image_size=(512, 1024),
+                micro_batch=16,
+                sync_period=64,
+                compact_batch=True,
+                dataset="synthetic_hard",
+                head_dtype="bfloat16",
+                width_divisor=1,
                 learning_rate=lr,
             )
             results.append(rec)
