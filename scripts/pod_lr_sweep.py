@@ -21,14 +21,20 @@ docs/flagship_recipe/):
   point IS the shipped point.  LR sweep {2e-3, 3e-3, 4e-3} brackets
   sqrt-scaling from the 512-batch curve's 2e-3.
 - reference-parity arch (stem none, fp32 head, no refinement) at global
-  super-batch 1024 (the v5e-8 ref-parity zoo point), LR {1e-3, 2e-3}.
+  super-batch 1024 (the v5e-8 ref-parity zoo point), LR {1e-3, 2e-3};
+- the v5e-64 Cityscapes row's architecture (s2d×4, full width, bf16 head)
+  at its geometry (512×1024) and its global batch (micro 16 × 64 chips =
+  1024), LR {1e-3, 2e-3} — geometry-faithful on the 6-class hard task
+  (class-count proxy for Cityscapes' 19, stated in the config notes).
 
 Step budgets hold the flagship curve's protocol (optimizer steps, not
 epochs — one step consumes the whole wrapped dataset several times over
 at these batches).  Results land next to the flagship curves in
-docs/flagship_recipe/ and back configs/vaihingen_unet_v5e8.json.
+docs/flagship_recipe/ and back configs/vaihingen_unet_v5e8.json and
+configs/cityscapes_unet_v5e64.json.
 
-Usage: python scripts/pod_lr_sweep.py [--steps 200] [--which flagship,ref]
+Usage: python scripts/pod_lr_sweep.py [--steps 300]
+       [--which flagship,ref,cityscapes]
 """
 
 from __future__ import annotations
