@@ -206,6 +206,14 @@ def main() -> None:
     args = p.parse_args()
 
     arms = args.arms.split(",")
+    if "jax" not in arms:
+        # The torch-only arm still computes mIoU through this framework's
+        # jnp metrics; force the CPU backend BEFORE any jax use so a
+        # dead/absent accelerator tunnel cannot block the final reduction
+        # (a 2 h torch run once hung exactly there).
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     train_ds, test_ds = make_data(args.size, dataset=args.dataset)
     config = {
         "arch": "reference-parity half-width U-Net (conv_transpose, BN)",
