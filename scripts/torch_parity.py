@@ -203,10 +203,16 @@ def main() -> None:
         "jax arm can run at different times without contending for the one "
         "host core / the one chip (512² round-4 protocol)",
     )
+    p.add_argument(
+        "--jax-platform", default="default",
+        help="'cpu' forces the CPU backend for this invocation (torch-only "
+        "arms force it automatically) — needed when the accelerator "
+        "tunnel is dead, and gives a same-hardware CPU-vs-CPU comparison",
+    )
     args = p.parse_args()
 
     arms = args.arms.split(",")
-    if "jax" not in arms:
+    if "jax" not in arms or args.jax_platform == "cpu":
         # The torch-only arm still computes mIoU through this framework's
         # jnp metrics; force the CPU backend BEFORE any jax use so a
         # dead/absent accelerator tunnel cannot block the final reduction
