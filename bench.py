@@ -375,6 +375,42 @@ def main() -> None:
     p.add_argument("--rounds", type=int, default=TIMED_ROUNDS)
     args = p.parse_args()
 
+    if not args.scaling:
+        # Deadline-bounded backend probe: a wedged device tunnel blocks
+        # jax.devices() FOREVER (observed mid-round-4); an explicit error
+        # line beats an infinite hang for any harness driving this.
+        import threading
+
+        probed: list = []
+
+        def _probe():
+            try:
+                probed.append(jax.devices())
+            except Exception as e:
+                probed.append(e)
+
+        t = threading.Thread(target=_probe, daemon=True)
+        t.start()
+        t.join(300.0)
+        if not probed or isinstance(probed[0], Exception):
+            print(
+                json.dumps(
+                    {
+                        "metric": f"{HEADLINE}_train_tiles_per_sec_per_chip",
+                        "value": None,
+                        "unit": "tiles/s/chip",
+                        "vs_baseline": None,
+                        "error": (
+                            "backend init timed out/failed — device tunnel "
+                            f"unreachable ({probed[0]!r})" if probed else
+                            "backend init timed out after 300 s — device "
+                            "tunnel unreachable"
+                        ),
+                    }
+                )
+            )
+            return
+
     if args.scaling:
         for rec in run_scaling():
             print(json.dumps(rec))
