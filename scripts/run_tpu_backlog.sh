@@ -1,0 +1,43 @@
+#!/bin/bash
+# The round-4 TPU backlog, blocked when the axon relay died mid-round
+# (docs/ROUND4.md "Environment incident").  Fire this as soon as a chip
+# is reachable — it polls for the backend, then drains the measurements
+# in priority order.  Every harness is idempotent (merge-by-tag /
+# per-row incremental writes).
+#
+#   nohup scripts/run_tpu_backlog.sh > /tmp/tpu_backlog.log 2>&1 &
+#
+# Expected outcomes (estimates from the round-4 traces):
+#  - pod_lr_sweep: LR curves backing configs/vaihingen_unet_v5e8.json
+#    (pod1024_flagship_lr*) and cityscapes_unet_v5e64.json
+#    (pod1024_cityscapes_lr*), plus the ref-parity 1024 point;
+#  - head_bench + zoo_variants + bench --all: the zoo re-measured with
+#    the fused loss (ops/losses.py:nll_correct_valid) — the grouped-
+#    layout arms shed ~70-90 ms/step (plain_grouped was 1798 with the
+#    OLD loss; the fused floor implies ~2300), the fullres flagship
+#    sheds its ~13 ms loss region (~1815 expected vs 1693);
+#  - unetpp_scope_ab: quality side of the ensemble-vs-per_head A/B
+#    (throughput already measured: per_head 384, ensemble 481, plain
+#    grouped 538 vs 678 pre-fused-loss);
+#  - torch_parity --arms jax: completes the 512² parity pair against
+#    the committed torch anchor (0.9787);
+#  - trace_step: post-fuse attribution for PERF.md.
+set -u
+export PYTHONPATH=/root/repo:/root/.axon_site
+cd /root/repo
+for i in $(seq 1 240); do
+  if timeout 90 python -c "import jax; assert jax.devices()" > /dev/null 2>&1; then
+    echo "TUNNEL UP after $i polls $(date)"
+    break
+  fi
+  sleep 60
+done
+timeout 90 python -c "import jax; assert jax.devices()" || { echo "TUNNEL NEVER RECOVERED"; exit 1; }
+echo "=== pod_lr_sweep ==="; timeout 7200 python scripts/pod_lr_sweep.py
+echo "=== head_bench ===";   timeout 2400 python scripts/head_bench.py
+echo "=== zoo_variants ==="; timeout 1200 python scripts/zoo_variants_bench.py
+echo "=== bench all ===";    timeout 2400 python bench.py --all
+echo "=== unetpp_scope ==="; timeout 3600 python scripts/unetpp_scope_ab.py
+echo "=== parity jax ===";   timeout 2400 python scripts/torch_parity.py --size 512 --epochs 15 --seeds 0 --dataset synthetic_hard --arms jax --out docs/parity/summary_hard_512.json
+echo "=== trace ===";        timeout 900 python scripts/trace_step.py --tag plain_grouped
+echo BACKLOG_DONE
