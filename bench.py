@@ -392,11 +392,16 @@ def main() -> None:
         t = threading.Thread(target=_probe, daemon=True)
         t.start()
         t.join(300.0)
+        if not probed:
+            # The probe is advisory: the daemon thread may finish init just
+            # after the deadline — one last look before declaring it dead.
+            t.join(5.0)
         if not probed or isinstance(probed[0], Exception):
+            requested = "all_zoo" if args.all else HEADLINE
             print(
                 json.dumps(
                     {
-                        "metric": f"{HEADLINE}_train_tiles_per_sec_per_chip",
+                        "metric": f"{requested}_train_tiles_per_sec_per_chip",
                         "value": None,
                         "unit": "tiles/s/chip",
                         "vs_baseline": None,

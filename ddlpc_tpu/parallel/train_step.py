@@ -112,7 +112,24 @@ def _loss_and_metrics(
     # [..., r², C] view — identical math (same multiset of (logit row,
     # label) pairs), no full-res tensor or d2s transpose in the train graph.
     if logits.shape[-3:-1] != labels.shape[-2:]:
+        # Only regroup when the model DECLARED the grouped layout — a model
+        # bug producing wrong-shaped logits whose dims happen to divide the
+        # labels must error, not silently train on scrambled pairings.
+        declared = getattr(model, "train_head_layout", "fullres")
+        if not (train and declared == "grouped"):
+            raise ValueError(
+                f"logits spatial shape {logits.shape[-3:-1]} != labels "
+                f"{labels.shape[-2:]} but the model declares "
+                f"train_head_layout={declared!r} (train={train}) — refusing "
+                "to reinterpret as grouped logits"
+            )
         r = labels.shape[-2] // logits.shape[-3]
+        if (labels.shape[-2] != r * logits.shape[-3]
+                or labels.shape[-1] != r * logits.shape[-2]):
+            raise ValueError(
+                f"grouped logits {logits.shape} are not an integer r×r "
+                f"regrouping of labels {labels.shape}"
+            )
         labels = group_labels(labels, r)
         logits = logits.reshape(*logits.shape[:-1], r * r, -1)
     # -1 marks void/ignored pixels (e.g. Cityscapes' unlabeled classes,

