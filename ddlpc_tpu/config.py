@@ -124,6 +124,14 @@ class DataConfig:
     # has none.  Requires square tiles; incompatible with device_cache
     # (augmentation happens in the host gather path).
     augment: bool = False
+    # Ship bf16 images + int8 labels through the ShardedLoader host-upload
+    # path (44% of the fp32 bytes on the host link).  Numerically identical
+    # for this zoo's bf16-compute models — their first conv casts inputs to
+    # bf16 regardless, and the loss clips/casts labels itself
+    # (tests/test_data.py pins step-level bit-identity).  Requires
+    # num_classes <= 127; rejected together with device_cache (which has
+    # its own compact feed, scripts/convergence_ab.py compact_batch).
+    compact_upload: bool = False
     # Upload the whole train set to HBM once and gather batches on device
     # (single-process, fixed-tile datasets that fit HBM — ISPRS scale is
     # ~0.5 GB).  Removes the per-epoch host→device re-upload, which on slow

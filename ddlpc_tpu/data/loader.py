@@ -24,6 +24,7 @@ from typing import Iterator, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import ml_dtypes
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -104,6 +105,7 @@ class ShardedLoader(_EpochSampler):
         space_axis: Optional[str] = None,
         prefetch: int = 2,
         tail: str = "wrap",
+        compact: bool = False,
     ):
         self.ds = dataset
         self.mesh = mesh
@@ -114,6 +116,15 @@ class ShardedLoader(_EpochSampler):
         self.data_axis = data_axis
         self.space_axis = space_axis
         self.prefetch = prefetch
+        # compact=True ships bf16 images + int8 labels over the host link —
+        # 44% of the fp32 bytes.  For this zoo's bf16-compute models the
+        # post-cast values are identical (the first conv casts inputs to
+        # bf16 regardless; the loss clips/casts labels itself): step-level
+        # bit-identity is test-pinned, and end-to-end fit() agrees to one
+        # fp32 ulp (XLA compiles a separate program per input dtype and may
+        # fuse a reduction differently).  Requires labels in [-1, 127];
+        # asserted per batch in the producer thread.
+        self.compact = compact
         self._epoch = 0
 
         nproc = jax.process_count()
@@ -154,6 +165,16 @@ class ShardedLoader(_EpochSampler):
             local = chunk[:, pid * Bl : (pid + 1) * Bl]  # [A, B_local]
             flat = local.reshape(-1)
             imgs, labs = self.ds.gather(flat)
+            if self.compact:
+                # Cast on the host (producer thread — overlaps consumer
+                # compute) so the upload moves 44% of the fp32 bytes.
+                if labs.min() < -1 or labs.max() > 127:
+                    raise ValueError(
+                        f"compact=True needs labels in [-1, 127] for int8, "
+                        f"got range [{labs.min()}, {labs.max()}]"
+                    )
+                imgs = imgs.astype(ml_dtypes.bfloat16)
+                labs = labs.astype(np.int8)
             yield (
                 imgs.reshape(A, Bl, *imgs.shape[1:]),
                 labs.reshape(A, Bl, *labs.shape[1:]),

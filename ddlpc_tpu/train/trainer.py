@@ -75,6 +75,18 @@ class Trainer:
                 "augmentation runs in the host gather path that the device "
                 "cache bypasses"
             )
+        if cfg.data.compact_upload and cfg.data.num_classes > 127:
+            raise ValueError(
+                f"data.compact_upload ships int8 labels, which cannot hold "
+                f"num_classes={cfg.data.num_classes} (max 127)"
+            )
+        if cfg.data.compact_upload and cfg.data.device_cache:
+            raise ValueError(
+                "data.compact_upload only affects the ShardedLoader host-"
+                "upload path; with device_cache use the device-resident "
+                "compact feed instead (scripts/convergence_ab.py "
+                "compact_batch)"
+            )
         self.mesh = make_mesh(cfg.parallel)
         data_size = self.mesh.shape[cfg.parallel.data_axis_name]
         self.global_micro_batch = cfg.train.micro_batch_size * data_size
@@ -112,6 +124,10 @@ class Trainer:
         loader_cls = (
             DeviceCachedLoader if cfg.data.device_cache else ShardedLoader
         )
+        loader_kw = (
+            {} if cfg.data.device_cache
+            else {"compact": cfg.data.compact_upload}
+        )
         self.loader = loader_cls(
             self.train_ds,
             self.mesh,
@@ -121,6 +137,7 @@ class Trainer:
             seed=cfg.data.seed,
             data_axis=cfg.parallel.data_axis_name,
             space_axis=space,
+            **loader_kw,
         )
         # Step horizon for decaying LR schedules comes from the loader (one
         # source of truth for steps/epoch, including tail semantics).

@@ -1,0 +1,37 @@
+#!/bin/bash
+# Round-5 TPU queue: the seed-spread runs (VERDICT r4 next #3/#8) that put
+# error bars on every shipped-decision table.  Designed to CHAIN after
+# scripts/run_tpu_backlog.sh (the round-4 drain): it waits for that
+# script's completion marker in its log (or, if that log does not exist,
+# just polls the backend itself), then runs the seed arms.  Idempotent —
+# rows merge by tag into docs/seed_spread/.
+#
+#   nohup scripts/run_tpu_backlog2.sh /tmp/tpu_backlog.log \
+#       > /tmp/tpu_backlog2.log 2>&1 &
+set -u
+export PYTHONPATH=/root/repo:/root/.axon_site
+cd /root/repo
+PRIOR_LOG="${1:-}"
+if [ -n "$PRIOR_LOG" ] && [ -f "$PRIOR_LOG" ]; then
+  for i in $(seq 1 400); do
+    if grep -q "BACKLOG_DONE\|TUNNEL NEVER RECOVERED" "$PRIOR_LOG"; then
+      break
+    fi
+    sleep 60
+  done
+  echo "prior backlog state: $(tail -1 "$PRIOR_LOG") ($(date))"
+fi
+for i in $(seq 1 120); do
+  if timeout 90 python -c "import jax; assert jax.devices()" > /dev/null 2>&1; then
+    echo "TUNNEL UP after $i polls $(date)"
+    break
+  fi
+  sleep 60
+done
+timeout 90 python -c "import jax; assert jax.devices()" || { echo "TUNNEL NEVER RECOVERED (backlog2)"; exit 1; }
+# Flagship codec arms first: they audit the shipped codec choice (fast —
+# ~3-5 min/arm on the chip at the 400-step protocol).
+echo "=== seed_spread flagship ==="; timeout 7200 python scripts/seed_spread.py --group flagship --seeds 1,2
+# DetailHead capacity + best stem-grid arm (120-epoch protocol).
+echo "=== seed_spread detail ===";   timeout 10800 python scripts/seed_spread.py --group detail --seeds 1,2
+echo BACKLOG2_DONE

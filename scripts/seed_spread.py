@@ -1,0 +1,221 @@
+"""Error bars for the decision-driving quality arms (VERDICT r4 next #3).
+
+Every shipped-decision delta in docs/HARD_TASK.md / docs/QUANTIZATION.md is
+one seed: h32 was promoted on +0.016, h64 kept off the zoo on +0.004, and
+the flagship codec table orders int8(0.939) > fp16(0.925) > none(0.922) —
+spreads that QUANTIZATION.md itself calls "within noise".  This script puts
+n≥3 behind each of those rows:
+
+- flagship codec arms {none, float16, int8-nearest} at the EXACT shipped
+  operating point (micro 128 × sync 4, lr 2e-3, hard task, 400 steps —
+  scripts/flagship_recipe.py protocol);
+- full-res DetailHead capacity arms {h16, h32, h64} and the best stem-grid
+  arm (s2dhead h128, grouped layout) at the EXACT r3/r4 sweep protocol
+  (micro 8 × sync 4, lr 1e-3, fp16, 120 epochs —
+  scripts/detail_sweep.py protocol).
+
+Seed 0 of every arm is already committed (docs/flagship_recipe/summary.json,
+docs/convergence_ab_hard120/summary.json) under the identical protocol, so
+only seeds 1..N-1 are trained (data seed is fixed inside run_variant — the
+spread measures init + codec noise, the thing the decisions ignored).  New
+curves land in docs/seed_spread/; `--aggregate` merges them with the
+committed seed-0 rows into docs/seed_spread/spread.json with mean/std/n and
+an ordering-stability verdict per decision.
+
+Usage:
+  python scripts/seed_spread.py [--group flagship|detail|all] [--seeds 1,2]
+  python scripts/seed_spread.py --aggregate   # (re)write spread.json only
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_SCRIPTS_DIR))
+sys.path.insert(0, _SCRIPTS_DIR)
+
+from convergence_ab import merge_summary, run_variant  # noqa: E402
+
+OUTDIR = "docs/seed_spread"
+
+# arm → (committed seed-0 summary, committed tag, run_variant kwargs)
+FLAGSHIP_BASE = dict(
+    stem_factor=4, epochs=400, micro_batch=128, sync_period=4,
+    dataset="synthetic_hard", head_dtype="bfloat16", detail_head=True,
+    detail_head_hidden=16, learning_rate=2e-3, rounding="nearest",
+)
+DETAIL_BASE = dict(
+    stem_factor=4, epochs=120, micro_batch=8, sync_period=4,
+    dataset="synthetic_hard", learning_rate=1e-3, rounding="nearest",
+)
+ARMS = {
+    # --- flagship codec decision (docs/QUANTIZATION.md flagship table)
+    "flagship_none": dict(
+        FLAGSHIP_BASE, mode="none",
+        seed0=("docs/flagship_recipe/summary.json",
+               "flagship_b128x4_lr0.002_none_nearest"),
+    ),
+    "flagship_fp16": dict(
+        FLAGSHIP_BASE, mode="float16",
+        seed0=("docs/flagship_recipe/summary.json",
+               "flagship_b128x4_lr0.002"),
+    ),
+    "flagship_int8": dict(
+        FLAGSHIP_BASE, mode="int8",
+        seed0=("docs/flagship_recipe/summary.json",
+               "flagship_b128x4_lr0.002_int8_nearest"),
+    ),
+    # --- DetailHead capacity decision (docs/HARD_TASK.md Pareto table)
+    "detail_h16": dict(
+        DETAIL_BASE, mode="float16", detail_head=True, detail_head_hidden=16,
+        seed0=("docs/convergence_ab_hard120/summary.json",
+               "stem4_detail_fp16_hard"),
+    ),
+    "detail_h32": dict(
+        DETAIL_BASE, mode="float16", detail_head=True, detail_head_hidden=32,
+        seed0=("docs/convergence_ab_hard120/summary.json",
+               "stem4_detail_h32_hard"),
+    ),
+    "detail_h64": dict(
+        DETAIL_BASE, mode="float16", detail_head=True, detail_head_hidden=64,
+        seed0=("docs/convergence_ab_hard120/summary.json",
+               "stem4_detail_h64_hard"),
+    ),
+    # --- best stem-grid arm (grouped layout)
+    "s2dhead_h128": dict(
+        DETAIL_BASE, mode="float16", detail_head=True,
+        detail_head_kind="s2d", detail_head_hidden=128,
+        train_head_layout="grouped",
+        seed0=("docs/convergence_ab_hard120/summary.json",
+               "stem4_s2dhead_h128_hard"),
+    ),
+}
+GROUPS = {
+    "flagship": ["flagship_none", "flagship_fp16", "flagship_int8"],
+    "detail": ["detail_h16", "detail_h32", "detail_h64", "s2dhead_h128"],
+}
+GROUPS["all"] = GROUPS["flagship"] + GROUPS["detail"]
+
+
+def _committed_seed0(arm: str) -> "float | None":
+    path, tag = ARMS[arm]["seed0"]
+    if not os.path.exists(path):
+        return None
+    for row in json.load(open(path)):
+        if row.get("tag") == tag:
+            return float(row["val_miou"])
+    return None
+
+
+def run(arms: "list[str]", seeds: "list[int]") -> None:
+    results = []
+    for arm in arms:
+        kw = {k: v for k, v in ARMS[arm].items() if k != "seed0"}
+        epochs = kw.pop("epochs")
+        stem_factor = kw.pop("stem_factor")
+        mode = kw.pop("mode")
+        for seed in seeds:
+            tag = f"{arm}_s{seed}"
+            rec = run_variant(
+                tag, stem_factor, mode, epochs, OUTDIR, seed=seed, **kw
+            )
+            results.append(rec)
+            print(json.dumps(rec), flush=True)
+            merge_summary(OUTDIR, results)  # incremental: a hung arm keeps rows
+
+
+def aggregate() -> dict:
+    import numpy as np
+
+    by_tag = {}
+    spath = os.path.join(OUTDIR, "summary.json")
+    if os.path.exists(spath):
+        for row in json.load(open(spath)):
+            by_tag[row["tag"]] = float(row["val_miou"])
+    out = {"arms": {}, "protocols": {
+        "flagship_*": "micro128×sync4 lr2e-3 hard 400 steps (flagship_recipe.py)",
+        "detail_*/s2dhead_*": "micro8×sync4 lr1e-3 fp16 hard 120 epochs (detail_sweep.py)",
+    }}
+    for arm in ARMS:
+        vals, seeds = [], []
+        s0 = _committed_seed0(arm)
+        if s0 is not None:
+            vals.append(s0)
+            seeds.append(0)
+        for tag, v in sorted(by_tag.items()):
+            if tag.startswith(arm + "_s"):
+                vals.append(v)
+                seeds.append(int(tag.rsplit("_s", 1)[1]))
+        if vals:
+            out["arms"][arm] = {
+                "seeds": seeds,
+                "val_miou": [round(v, 4) for v in vals],
+                "mean": round(float(np.mean(vals)), 4),
+                "std": round(float(np.std(vals, ddof=1)), 4) if len(vals) > 1
+                else None,
+                "n": len(vals),
+            }
+
+    def m(arm):
+        return out["arms"].get(arm, {}).get("mean")
+
+    def s(arm):
+        return out["arms"].get(arm, {}).get("std") or 0.0
+
+    # The decisions the spread exists to audit, restated with error bars.
+    decisions = {}
+    if m("detail_h32") is not None and m("detail_h16") is not None:
+        d = m("detail_h32") - m("detail_h16")
+        sigma = max(s("detail_h32"), s("detail_h16"))
+        decisions["h32_promotion"] = {
+            "delta_mean": round(d, 4), "max_sigma": round(sigma, 4),
+            "stable": bool(sigma and d > 2 * sigma) if sigma else None,
+        }
+    if m("detail_h64") is not None and m("detail_h32") is not None:
+        d = m("detail_h64") - m("detail_h32")
+        sigma = max(s("detail_h64"), s("detail_h32"))
+        decisions["h64_exclusion"] = {
+            "delta_mean": round(d, 4), "max_sigma": round(sigma, 4),
+            "within_noise": bool(sigma and abs(d) <= 2 * sigma) if sigma
+            else None,
+        }
+    order = sorted(
+        (a for a in GROUPS["flagship"] if m(a) is not None),
+        key=m, reverse=True,
+    )
+    if order:
+        decisions["flagship_codec_order"] = {
+            "by_mean": order,
+            "spread": {a: [m(a), s(a)] for a in order},
+        }
+    out["decisions"] = decisions
+    os.makedirs(OUTDIR, exist_ok=True)
+    with open(os.path.join(OUTDIR, "spread.json"), "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out["decisions"], indent=2))
+    return out
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--group", default="all", choices=sorted(GROUPS))
+    p.add_argument("--seeds", default="1,2")
+    p.add_argument("--only", default="", help="comma list of arm names")
+    p.add_argument("--aggregate", action="store_true",
+                   help="only (re)write spread.json from existing rows")
+    args = p.parse_args()
+    if not args.aggregate:
+        arms = [a for a in args.only.split(",") if a] or GROUPS[args.group]
+        unknown = [a for a in arms if a not in ARMS]
+        if unknown:
+            raise SystemExit(f"unknown arms: {unknown} (have {sorted(ARMS)})")
+        run(arms, [int(s) for s in args.seeds.split(",") if s])
+    aggregate()
+
+
+if __name__ == "__main__":
+    main()

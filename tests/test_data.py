@@ -546,3 +546,66 @@ def test_eval_batches_padding_masks_labels(mesh):
     # 10 tiles → tail batch has 2 valid + 6 padded(-1) samples.
     assert (labs_tail[:2] >= 0).all()
     assert (labs_tail[2:] == -1).all()
+
+
+def test_compact_upload_bit_identical_training(mesh):
+    """ShardedLoader(compact=True) ships bf16 images + int8 labels; for a
+    bf16-compute model (whose first conv casts inputs to bf16 regardless)
+    the training trajectory must be IDENTICAL to the fp32 feed — the same
+    property the device-cache compact feed pinned in round 4, now on the
+    host-upload path."""
+    import optax
+
+    from ddlpc_tpu.config import CompressionConfig, ModelConfig
+    from ddlpc_tpu.models import build_model
+    from ddlpc_tpu.parallel.train_step import (
+        create_train_state,
+        make_train_step,
+    )
+
+    ds = SyntheticTiles(num_tiles=32, image_size=(16, 16), num_classes=5, seed=2)
+    model = build_model(
+        ModelConfig(features=(8, 16), bottleneck_features=16, num_classes=5),
+        norm_axis_name="data",
+    )
+    tx = optax.adam(1e-3)
+
+    def run(compact):
+        state = create_train_state(
+            model, tx, jax.random.key(0), (1, 16, 16, 3)
+        )
+        step = make_train_step(
+            model, tx, mesh, CompressionConfig(mode="none"),
+            donate_state=False,
+        )
+        loader = ShardedLoader(
+            ds, mesh, global_micro_batch=8, sync_period=2, seed=3,
+            prefetch=0, compact=compact,
+        )
+        losses = []
+        for epoch in range(2):
+            loader.set_epoch(epoch)
+            for imgs, labs in loader:
+                if compact:
+                    assert imgs.dtype == jnp.bfloat16
+                    assert labs.dtype == jnp.int8
+                state, metrics = step(state, imgs, labs)
+                losses.append(float(metrics["loss"]))
+        return losses
+
+    import jax.numpy as jnp
+
+    np.testing.assert_array_equal(run(False), run(True))
+
+
+def test_compact_upload_rejects_wide_labels(mesh):
+    ds = TileDataset(
+        np.zeros((8, 8, 8, 3), np.float32),
+        np.full((8, 8, 8), 200, np.int32),
+    )
+    loader = ShardedLoader(
+        ds, mesh, global_micro_batch=8, sync_period=1, prefetch=0,
+        compact=True,
+    )
+    with pytest.raises(ValueError, match=r"\[-1, 127\]"):
+        next(iter(loader))

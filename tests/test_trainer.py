@@ -307,3 +307,33 @@ def test_stochastic_rounding_large_batch_warns(tmp_path):
     with _warnings.catch_warnings():
         _warnings.simplefilter("error")
         build(-(-256 // (4 * n_dev)), 4, "nearest")  # large but deterministic
+
+
+def test_compact_upload_config_validation(tmp_path):
+    """compact_upload's int8 labels cap num_classes at 127, and the flag is
+    meaningless (and therefore rejected) under device_cache."""
+    import dataclasses
+
+    cfg = tiny_config(str(tmp_path))
+    wide = dataclasses.replace(
+        cfg,
+        model=dataclasses.replace(cfg.model, num_classes=200),
+        data=dataclasses.replace(
+            cfg.data, num_classes=200, compact_upload=True
+        ),
+    )
+    with pytest.raises(ValueError, match="max 127"):
+        Trainer(wide, resume=False)
+    cached = dataclasses.replace(
+        cfg,
+        data=dataclasses.replace(
+            cfg.data, compact_upload=True, device_cache=True
+        ),
+    )
+    with pytest.raises(ValueError, match="compact_upload"):
+        Trainer(cached, resume=False)
+    # Valid flag reaches the loader.
+    ok = dataclasses.replace(
+        cfg, data=dataclasses.replace(cfg.data, compact_upload=True)
+    )
+    assert Trainer(ok, resume=False).loader.compact is True
