@@ -119,6 +119,12 @@ class DataConfig:
     # the held-out scenes (capped at ``test_split`` tiles).
     crops_per_epoch: int = 0
     test_split_scenes: int = 1  # scenes held out for eval in crop mode
+    # Memory-map scene arrays instead of eager-loading them (crop mode
+    # only): resident memory stays at the cropped pages, which is what
+    # makes Potsdam-scale corpora (~25 GB eager) feasible.  Requires
+    # array-format scenes (prepare_isprs.py --format npy); crops are
+    # bit-identical to the eager path (tests/test_data.py).
+    mmap_scenes: bool = False
     # Dihedral-group augmentation (4 rotations × optional flip) on training
     # tiles — standard for orientation-free aerial imagery; the reference
     # has none.  Requires square tiles; incompatible with device_cache

@@ -119,6 +119,13 @@ class CropDataset:
     same global crop plan — exactly the property the sharded loader's
     shared-permutation sampling relies on (loader.py).  Scenes are sampled
     proportionally to their croppable area.
+
+    Scene dtype is the normalization contract: **uint8 scenes are raw
+    images** (the ``load_scene_dir(mmap=True)`` format) and gather()
+    normalizes each crop with the same ``astype(float32)/255`` the eager
+    loader applies; float scenes are taken as already normalized.  Callers
+    holding uint8 data that is NOT 0-255 imagery must convert to float
+    themselves.
     """
 
     def __init__(
@@ -138,6 +145,8 @@ class CropDataset:
                     f"scene {i}: image {img.shape[:2]} != label {lab.shape[:2]}"
                 )
             # int32 before the -1 pad (uint8 would wrap void to 255).
+            # np.asarray on an already-int32 memory map is a no-copy view,
+            # so mmap scenes (load_scene_dir(mmap=True)) stay on disk.
             lab = np.asarray(lab, np.int32)
             if img.shape[0] < ch or img.shape[1] < cw:
                 # Pad undersized scenes up to one crop (reference pads
@@ -146,12 +155,11 @@ class CropDataset:
                 pad_h, pad_w = max(ch - img.shape[0], 0), max(cw - img.shape[1], 0)
                 img = np.pad(img, ((0, pad_h), (0, pad_w), (0, 0)))
                 lab = np.pad(lab, ((0, pad_h), (0, pad_w)), constant_values=-1)
-            self.scenes.append(
-                (
-                    np.ascontiguousarray(img, np.float32),
-                    np.ascontiguousarray(lab, np.int32),
-                )
-            )
+            # uint8 images (the mmap format) are kept as-is — gather()
+            # normalizes per crop; anything else is materialized float32.
+            if img.dtype != np.uint8:
+                img = np.ascontiguousarray(img, np.float32)
+            self.scenes.append((img, lab))
         self.crop_size = (ch, cw)
         self.crops_per_epoch = int(crops_per_epoch)
         if self.crops_per_epoch <= 0:
@@ -203,6 +211,10 @@ class CropDataset:
             s, y0, x0 = plan[idx]
             img, lab = self.scenes[s]
             imgs[out] = img[y0 : y0 + ch, x0 : x0 + cw]
+            if img.dtype == np.uint8:
+                # mmap format: normalize per crop — same astype(f32)/255 as
+                # load_image_file, so eager and mmap crops are bit-identical.
+                imgs[out] /= 255.0
             labs[out] = lab[y0 : y0 + ch, x0 : x0 + cw]
         return imgs, labs
 
@@ -286,6 +298,9 @@ def grid_tiles(
 
     The fixed-tile counterpart of :class:`CropDataset` for held-out
     evaluation: mIoU must be computed on the same tiles every epoch.
+    Same dtype contract as CropDataset: uint8 scenes are raw images and
+    get the eager loader's ``astype(float32)/255``; float scenes are
+    taken as already normalized.
     """
     th, tw = tile_size
     images, labels = [], []
@@ -296,7 +311,10 @@ def grid_tiles(
                 tile_lab = lab[y : y + th, x : x + tw]
                 if tile_img.shape[:2] != (th, tw):
                     continue
-                images.append(np.asarray(tile_img, np.float32))
+                t = np.asarray(tile_img, np.float32)
+                if tile_img.dtype == np.uint8:
+                    t /= 255.0  # mmap scenes are raw uint8 (load_scene_dir)
+                images.append(t)
                 labels.append(np.asarray(tile_lab, np.int32))
                 if max_tiles is not None and len(images) >= max_tiles:
                     break
@@ -311,25 +329,80 @@ def grid_tiles(
 
 
 def load_scene_dir(
-    path: str, channels: int = 3, normalize: bool = True
+    path: str, channels: int = 3, normalize: bool = True, mmap: bool = False
 ) -> "list[Tuple[np.ndarray, np.ndarray]]":
     """Directory of images + ``.npy`` masks at native size → scene list.
 
     Pairing is strict: image and mask must share a filename stem (modulo
     ``_mask``/``_label``/``_gt`` suffixes); unmatched files raise.
+
+    ``mmap=True`` memory-maps every array instead of loading it: resident
+    memory stays at the pages actually cropped, which is what makes
+    Potsdam-scale corpora (~25 GB eager) feasible on ordinary hosts — the
+    documented limit of the reference's eager design (кластер.py:660-674,
+    docs/PERF.md "Reference-scale scene pipeline").  Requires array-format
+    images (``<stem>_img.npy``, written by ``prepare_isprs.py --format
+    npy``); images stay uint8 and consumers (:class:`CropDataset`,
+    :func:`grid_tiles`) normalize per crop — bit-identical to the eager
+    ``float32/255`` path.
     """
+    if mmap and not normalize:
+        raise ValueError(
+            "mmap=True keeps scenes uint8 and consumers normalize per crop "
+            "— normalize=False cannot be honored; load eagerly instead"
+        )
     img_by_stem, npy_by_stem = _paired_files(path)
     scenes = []
     for s in sorted(img_by_stem):
-        img = load_image_file(
-            img_by_stem[s], None, channels=channels, normalize=normalize
-        )
-        lab = np.load(npy_by_stem[s]).astype(np.int32)
+        img_path = img_by_stem[s]
+        if img_path.endswith(".npy"):
+            img = np.load(img_path, mmap_mode="r" if mmap else None)
+            if img.ndim == 2:
+                img = img[..., None]
+            if img.shape[-1] != channels:
+                raise ValueError(
+                    f"{img_path}: array images must have {channels} "
+                    f"channels, got shape {img.shape}"
+                )
+            if mmap and img.dtype != np.uint8:
+                raise ValueError(
+                    f"{img_path}: mmap images must be uint8 (the "
+                    f"prepare_* converters write uint8; other dtypes would "
+                    f"be silently materialized and mis-scaled downstream), "
+                    f"got {img.dtype}"
+                )
+            if not mmap:
+                img = img.astype(np.float32)
+                if normalize:
+                    img /= 255.0
+        elif mmap:
+            raise ValueError(
+                f"mmap=True needs array-format images (<stem>_img.npy), "
+                f"got {img_path}; re-run scripts/prepare_isprs.py with "
+                f"--format npy"
+            )
+        else:
+            img = load_image_file(
+                img_path, None, channels=channels, normalize=normalize
+            )
+        lab = np.load(npy_by_stem[s], mmap_mode="r" if mmap else None)
+        if not mmap:
+            lab = lab.astype(np.int32)
+        elif lab.dtype != np.int32:
+            raise ValueError(
+                f"{npy_by_stem[s]}: mmap masks must be int32 (the "
+                f"prepare_* converters write int32), got {lab.dtype}"
+            )
         scenes.append((img, lab))
     return scenes
 
 
-LABEL_SUFFIXES = ("_mask", "_label", "_labels", "_gt", "_noBoundary", "_RGB")
+LABEL_SUFFIXES = (
+    "_mask", "_label", "_labels", "_gt", "_noBoundary", "_RGB",
+    # prepare_isprs.py --format npy writes mmap-able images as
+    # <stem>_img.npy; strip the marker so they pair with <stem>.npy masks.
+    "_img",
+)
 
 
 def file_stem(name: str, suffixes: Tuple[str, ...] = LABEL_SUFFIXES) -> str:
@@ -360,7 +433,14 @@ def _paired_files(path: str) -> Tuple[dict, dict]:
         full = os.path.join(path, name)
         if not os.path.isfile(full):
             continue
-        table = npy_by_stem if name.endswith(".npy") else img_by_stem
+        # <stem>_img.npy is an IMAGE stored as a (mmap-able) array, not a
+        # mask — route it to the image table despite the .npy extension.
+        if name.endswith("_img.npy"):
+            table = img_by_stem
+        elif name.endswith(".npy"):
+            table = npy_by_stem
+        else:
+            table = img_by_stem
         s = stem(name)
         if s in table:
             raise ValueError(
@@ -662,9 +742,15 @@ def build_dataset(cfg: DataConfig):
                 stacklevel=2,
             )
     channels = (spec or DATASET_SPECS["synthetic"])["channels"]
+    if cfg.mmap_scenes and (not cfg.data_dir or cfg.crops_per_epoch <= 0):
+        raise ValueError(
+            "mmap_scenes needs crop mode over a scene directory "
+            "(data_dir set and crops_per_epoch > 0); fixed-tile and "
+            "synthetic datasets are loaded eagerly"
+        )
     if cfg.crops_per_epoch > 0:
         scenes = (
-            load_scene_dir(cfg.data_dir)
+            load_scene_dir(cfg.data_dir, mmap=cfg.mmap_scenes)
             if cfg.data_dir
             else _synthetic_scenes(cfg, channels)
         )

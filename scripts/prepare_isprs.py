@@ -68,7 +68,13 @@ from ddlpc_tpu.data.datasets import file_stem as _stem  # noqa: E402
 _IMAGE_EXTS = (".tif", ".tiff", ".png", ".jpg", ".jpeg", ".bmp")
 
 
-def convert(images_dir: str, labels_dir: str, out_dir: str, limit: int = 0) -> int:
+def convert(
+    images_dir: str,
+    labels_dir: str,
+    out_dir: str,
+    limit: int = 0,
+    fmt: str = "png",
+) -> int:
     import imageio.v2 as imageio
     from PIL import Image
 
@@ -101,7 +107,23 @@ def convert(images_dir: str, labels_dir: str, out_dir: str, limit: int = 0) -> i
             raise ValueError(
                 f"{stem}: image {img.shape[:2]} != label {mask.shape}"
             )
-        imageio.imwrite(os.path.join(out_dir, f"{stem}.png"), img)
+        if fmt == "npy":
+            # Array-format images: uint8 <stem>_img.npy, memory-mappable by
+            # load_scene_dir(mmap=True) — the Potsdam-scale path where
+            # eager decode would need ~25 GB resident.
+            if img.dtype != np.uint8:
+                raise ValueError(
+                    f"{name}: --format npy requires uint8 source imagery, "
+                    f"got {img.dtype} — an astype would wrap values mod 256 "
+                    f"(300 → 44); rescale 16-bit sources first or use "
+                    f"--format png"
+                )
+            np.save(
+                os.path.join(out_dir, f"{stem}_img.npy"),
+                np.ascontiguousarray(img),
+            )
+        else:
+            imageio.imwrite(os.path.join(out_dir, f"{stem}.png"), img)
         np.save(os.path.join(out_dir, f"{stem}.npy"), mask)
         n += 1
         if limit and n >= limit:
@@ -117,8 +139,13 @@ def main() -> None:
     p.add_argument("--labels", required=True, help="dir of color-coded GT")
     p.add_argument("--out", required=True)
     p.add_argument("--limit", type=int, default=0)
+    p.add_argument(
+        "--format", default="png", choices=["png", "npy"], dest="fmt",
+        help="npy writes mmap-able uint8 <stem>_img.npy images for "
+             "load_scene_dir(mmap=True) / DataConfig.mmap_scenes",
+    )
     args = p.parse_args()
-    n = convert(args.images, args.labels, args.out, args.limit)
+    n = convert(args.images, args.labels, args.out, args.limit, fmt=args.fmt)
     print(f"wrote {n} (image, index-mask) scene pairs to {args.out}")
 
 

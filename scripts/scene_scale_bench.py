@@ -87,14 +87,15 @@ def write_fixtures(root: str, n_scenes: int, seed: int = 11) -> dict:
     }
 
 
-def run_converter(tops: str, gts: str, out_dir: str) -> dict:
+def run_converter(tops: str, gts: str, out_dir: str, fmt: str = "png") -> dict:
     """The real prepare_isprs.py over the full scene set, as a subprocess
     (its peak RSS lands in RUSAGE_CHILDREN, separable from ours)."""
     before = resource.getrusage(resource.RUSAGE_CHILDREN).ru_maxrss
     t0 = time.perf_counter()
     proc = subprocess.run(
         [sys.executable, os.path.join(_SCRIPTS_DIR, "prepare_isprs.py"),
-         "--images", tops, "--labels", gts, "--out", out_dir],
+         "--images", tops, "--labels", gts, "--out", out_dir,
+         "--format", fmt],
         capture_output=True, text=True, timeout=3600,
     )
     dt = time.perf_counter() - t0
@@ -121,13 +122,17 @@ rec = {{}}
 def rss_mb():
     return round(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1)
 
-# -- phase: eager whole-dir load (the reference's design, кластер.py:660-674)
+MMAP = {mmap}
+PFX = "mmap_" if MMAP else "eager_"
+# -- phase: whole-dir load.  Eager = the reference's design
+# (кластер.py:660-674); mmap = the round-5 escape hatch for corpora whose
+# eager bill doesn't fit (load_scene_dir(mmap=True), uint8 npy scenes).
 t0 = time.perf_counter()
-scenes = load_scene_dir({scene_dir!r})
-rec["eager_load_s"] = round(time.perf_counter() - t0, 2)
-rec["eager_scenes"] = len(scenes)
-rec["eager_peak_rss_mb"] = rss_mb()
-rec["eager_bytes_mb"] = round(sum(
+scenes = load_scene_dir({scene_dir!r}, mmap=MMAP)
+rec[PFX + "load_s"] = round(time.perf_counter() - t0, 2)
+rec[PFX + "scenes"] = len(scenes)
+rec[PFX + "peak_rss_mb"] = rss_mb()
+rec[PFX + "bytes_mb"] = round(sum(
     i.nbytes + l.nbytes for i, l in scenes) / 2**20, 1)
 
 # -- phase: CropDataset host throughput at the reference crop size
@@ -141,9 +146,13 @@ for epoch in range(2):
         idx = np.arange(start, min(start + 32, len(aug)))
         imgs, labs = aug.gather(idx)
         n += len(idx)
-rec["crop_throughput_per_s"] = round(n / (time.perf_counter() - t0), 1)
-rec["crop_peak_rss_mb"] = rss_mb()
+rec[PFX + "crop_throughput_per_s"] = round(n / (time.perf_counter() - t0), 1)
+rec[PFX + "crop_peak_rss_mb"] = rss_mb()
 del aug, ds, scenes
+
+if not {do_fit}:
+    print("CHILD_JSON " + json.dumps(rec))
+    raise SystemExit(0)
 
 # -- phase: real Trainer.fit() from those crops, CPU backend
 from ddlpc_tpu.config import (CompressionConfig, DataConfig, ExperimentConfig,
@@ -179,9 +188,13 @@ print("CHILD_JSON " + json.dumps(rec))
 """
 
 
-def run_load_and_fit(scene_dir: str, workdir: str, steps: int) -> dict:
+def run_load_and_fit(
+    scene_dir: str, workdir: str, steps: int,
+    mmap: bool = False, do_fit: bool = True,
+) -> dict:
     code = _CHILD_CODE.format(
-        repo=_REPO, scene_dir=scene_dir, workdir=workdir, steps=steps
+        repo=_REPO, scene_dir=scene_dir, workdir=workdir, steps=steps,
+        mmap=mmap, do_fit=do_fit,
     )
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + ":" + env.get("PYTHONPATH", "")
@@ -205,6 +218,9 @@ def main() -> None:
     p.add_argument("--out", default="docs/disk_fit/scene_scale.json")
     p.add_argument("--keep-fixtures", default="",
                    help="persist fixtures/converted scenes here (else tmp)")
+    p.add_argument("--mode", default="full", choices=["full", "mmap-only"],
+                   help="mmap-only: converter --format npy + the mmap load/"
+                        "crop arm only, merged into an existing --out")
     args = p.parse_args()
 
     root_ctx = (
@@ -213,35 +229,60 @@ def main() -> None:
     )
     root = root_ctx.name if root_ctx else args.keep_fixtures
     os.makedirs(root, exist_ok=True)
+    mmap_only = args.mode == "mmap-only"
     try:
-        rec = {"sizes_px": SIZES, "crop_size": 512}
+        rec = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                prior = json.load(f)
+            # Only merge arms measured on the SAME corpus — mixing a
+            # 33-scene eager arm with a 10-scene mmap arm under one header
+            # would be an apples-to-oranges table with no provenance.
+            if prior.get("n_scenes") == args.scenes:
+                rec = prior
+            else:
+                print(f"note: {args.out} holds a {prior.get('n_scenes')}-"
+                      f"scene run; starting fresh for --scenes "
+                      f"{args.scenes}", flush=True)
+        rec.update({"sizes_px": SIZES, "crop_size": 512})
         print(f"[1/4] fixtures → {root}", flush=True)
         rec.update(write_fixtures(root, args.scenes))
         print(f"      {rec['n_scenes']} scenes, {rec['total_mpix']} MPix "
               f"in {rec['fixture_gen_s']}s", flush=True)
 
-        scene_dir = os.path.join(root, "scenes")
-        print("[2/4] real converter (prepare_isprs.py)", flush=True)
-        rec.update(run_converter(
-            os.path.join(root, "top"), os.path.join(root, "gts"), scene_dir
-        ))
-        rec["convert_mpix_per_s"] = round(
-            rec["total_mpix"] / rec["convert_s"], 2
-        )
-        print(f"      {rec['convert_s']}s "
-              f"({rec['convert_mpix_per_s']} MPix/s, "
-              f"peak RSS {rec['converter_peak_rss_mb']} MB)", flush=True)
-
-        print("[3/4+4/4] eager load + crops + fit() (subprocess, CPU)",
+        fmt = "npy" if mmap_only else "png"
+        scene_dir = os.path.join(root, "scenes_" + fmt)
+        print(f"[2/4] real converter (prepare_isprs.py, --format {fmt})",
               flush=True)
+        conv = run_converter(
+            os.path.join(root, "top"), os.path.join(root, "gts"), scene_dir,
+            fmt=fmt,
+        )
+        pfx = "npy_" if mmap_only else ""
+        rec.update({pfx + k: v for k, v in conv.items()})
+        rec[pfx + "convert_mpix_per_s"] = round(
+            rec["total_mpix"] / conv["convert_s"], 2
+        )
+        print(f"      {conv['convert_s']}s "
+              f"({rec[pfx + 'convert_mpix_per_s']} MPix/s, "
+              f"peak RSS {conv['converter_peak_rss_mb']} MB)", flush=True)
+
+        label = "mmap load + crops" if mmap_only else "eager load + crops + fit()"
+        print(f"[3/4+4/4] {label} (subprocess, CPU)", flush=True)
         with tempfile.TemporaryDirectory(prefix="scene_fit_") as wd:
-            rec.update(run_load_and_fit(scene_dir, wd, args.steps))
-        print(f"      eager {rec['eager_load_s']}s / "
-              f"{rec['eager_peak_rss_mb']} MB RSS "
-              f"({rec['eager_bytes_mb']} MB arrays); "
-              f"crops {rec['crop_throughput_per_s']}/s; "
-              f"fit {rec['fit_tiles_per_s']} tiles/s "
-              f"on {rec['fit_backend']}", flush=True)
+            rec.update(run_load_and_fit(
+                scene_dir, wd, args.steps,
+                mmap=mmap_only, do_fit=not mmap_only,
+            ))
+        arm = "mmap" if mmap_only else "eager"
+        msg = (f"      {arm} {rec[arm + '_load_s']}s / "
+               f"{rec[arm + '_peak_rss_mb']} MB RSS "
+               f"({rec[arm + '_bytes_mb']} MB arrays); "
+               f"crops {rec[arm + '_crop_throughput_per_s']}/s")
+        if not mmap_only:
+            msg += (f"; fit {rec['fit_tiles_per_s']} tiles/s "
+                    f"on {rec['fit_backend']}")
+        print(msg, flush=True)
 
         os.makedirs(os.path.dirname(args.out), exist_ok=True)
         with open(args.out, "w") as f:

@@ -609,3 +609,26 @@ def test_compact_upload_rejects_wide_labels(mesh):
     )
     with pytest.raises(ValueError, match=r"\[-1, 127\]"):
         next(iter(loader))
+
+
+def test_mmap_scenes_config_validation_and_grid_tiles(tmp_path):
+    """mmap_scenes needs crop mode over a scene dir; grid_tiles normalizes
+    uint8 (mmap-format) scenes the same way the eager loader does."""
+    from ddlpc_tpu.data.datasets import grid_tiles
+
+    with pytest.raises(ValueError, match="mmap_scenes"):
+        build_dataset(DataConfig(dataset="synthetic", mmap_scenes=True))
+    with pytest.raises(ValueError, match="mmap_scenes"):
+        build_dataset(
+            DataConfig(
+                dataset="synthetic", mmap_scenes=True, crops_per_epoch=4
+            )
+        )
+
+    rng = np.random.default_rng(5)
+    u8 = rng.integers(0, 255, (16, 16, 3), dtype=np.uint8)
+    lab = rng.integers(0, 6, (16, 16)).astype(np.int32)
+    f32 = u8.astype(np.float32) / 255.0
+    tiles_u8 = grid_tiles([(u8, lab)], (8, 8))
+    tiles_f32 = grid_tiles([(f32, lab)], (8, 8))
+    np.testing.assert_array_equal(tiles_u8.images, tiles_f32.images)

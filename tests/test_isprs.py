@@ -88,3 +88,64 @@ def test_convert_missing_label_raises(tmp_path):
     )
     with pytest.raises(FileNotFoundError, match="no label"):
         convert(str(tmp_path / "top"), str(tmp_path / "gts"), str(tmp_path / "o"))
+
+
+def test_convert_npy_format_mmap_matches_eager(tmp_path):
+    """--format npy + load_scene_dir(mmap=True) must produce bit-identical
+    crops to the png/eager chain (mmap scenes stay uint8; CropDataset
+    normalizes per crop with the same astype(f32)/255)."""
+    import imageio.v2 as imageio
+
+    from ddlpc_tpu.data import CropDataset, load_scene_dir
+
+    img_dir, lab_dir = tmp_path / "top", tmp_path / "gts"
+    out_png, out_npy = tmp_path / "scenes_png", tmp_path / "scenes_npy"
+    img_dir.mkdir()
+    lab_dir.mkdir()
+    rng = np.random.default_rng(3)
+    for i in range(2):
+        h, w = 48, 64 + 8 * i
+        imageio.imwrite(
+            img_dir / f"top_mosaic_{i}.png",
+            rng.integers(0, 255, (h, w, 3), dtype=np.uint8),
+        )
+        imageio.imwrite(
+            lab_dir / f"top_mosaic_{i}_label.png",
+            ISPRS_COLORS[rng.integers(0, 6, (h, w))],
+        )
+    assert convert(str(img_dir), str(lab_dir), str(out_png)) == 2
+    assert convert(str(img_dir), str(lab_dir), str(out_npy), fmt="npy") == 2
+
+    eager = load_scene_dir(str(out_png))
+    mm = load_scene_dir(str(out_npy), mmap=True)
+    assert len(eager) == len(mm) == 2
+    for (ei, el), (mi, ml) in zip(eager, mm):
+        assert mi.dtype == np.uint8 and isinstance(mi, np.memmap)
+        assert ml.dtype == np.int32 and isinstance(ml, np.memmap)
+        np.testing.assert_array_equal(el, np.asarray(ml))
+
+    # Same seed → same crop plan → bit-identical gathered crops.
+    ds_e = CropDataset(eager, (32, 32), crops_per_epoch=16, seed=7)
+    ds_m = CropDataset(mm, (32, 32), crops_per_epoch=16, seed=7)
+    for epoch in range(2):
+        ds_e.set_epoch(epoch)
+        ds_m.set_epoch(epoch)
+        xe, ye = ds_e.gather(np.arange(16))
+        xm, ym = ds_m.gather(np.arange(16))
+        np.testing.assert_array_equal(xe, xm)
+        np.testing.assert_array_equal(ye, ym)
+        assert xm.dtype == np.float32 and xm.max() <= 1.0
+
+
+def test_load_scene_dir_mmap_rejects_png(tmp_path):
+    import imageio.v2 as imageio
+    import pytest
+
+    from ddlpc_tpu.data import load_scene_dir
+
+    imageio.imwrite(
+        tmp_path / "a.png", np.zeros((8, 8, 3), np.uint8)
+    )
+    np.save(tmp_path / "a.npy", np.zeros((8, 8), np.int32))
+    with pytest.raises(ValueError, match="--format npy"):
+        load_scene_dir(str(tmp_path), mmap=True)
