@@ -119,6 +119,12 @@ class DataConfig:
     # the held-out scenes (capped at ``test_split`` tiles).
     crops_per_epoch: int = 0
     test_split_scenes: int = 1  # scenes held out for eval in crop mode
+    # Fixed-tile mode: read tiles from disk per gather instead of stacking
+    # the whole directory resident (~20 GB for full Cityscapes at
+    # 512×1024).  The eval holdout stays eager (it is small by design and
+    # prediction dumps need arrays).  Prefer prepare_* --format npy tiles
+    # for decode-free reads; incompatible with device_cache.
+    lazy_tiles: bool = False
     # Memory-map scene arrays instead of eager-loading them (crop mode
     # only): resident memory stays at the cropped pages, which is what
     # makes Potsdam-scale corpora (~25 GB eager) feasible.  Requires

@@ -11,12 +11,14 @@ from ddlpc_tpu.data.datasets import (  # noqa: F401
     CropDataset,
     DihedralAugment,
     HardTiles,
+    LazyTileDataset,
     SyntheticTiles,
     TileDataset,
     build_dataset,
     dataset_defaults,
     grid_tiles,
     load_scene_dir,
+    load_tile_dir,
     train_test_split,
 )
 from ddlpc_tpu.data.loader import (  # noqa: F401

@@ -397,12 +397,7 @@ def load_scene_dir(
     return scenes
 
 
-LABEL_SUFFIXES = (
-    "_mask", "_label", "_labels", "_gt", "_noBoundary", "_RGB",
-    # prepare_isprs.py --format npy writes mmap-able images as
-    # <stem>_img.npy; strip the marker so they pair with <stem>.npy masks.
-    "_img",
-)
+LABEL_SUFFIXES = ("_mask", "_label", "_labels", "_gt", "_noBoundary", "_RGB")
 
 
 def file_stem(name: str, suffixes: Tuple[str, ...] = LABEL_SUFFIXES) -> str:
@@ -434,14 +429,18 @@ def _paired_files(path: str) -> Tuple[dict, dict]:
         if not os.path.isfile(full):
             continue
         # <stem>_img.npy is an IMAGE stored as a (mmap-able) array, not a
-        # mask — route it to the image table despite the .npy extension.
+        # mask — route it to the image table despite the .npy extension,
+        # stripping only the _img marker (kept out of LABEL_SUFFIXES so
+        # ordinary files whose names end in _img keep their stems).
         if name.endswith("_img.npy"):
             table = img_by_stem
+            s = stem(name.removesuffix("_img.npy"))
         elif name.endswith(".npy"):
             table = npy_by_stem
+            s = stem(name)
         else:
             table = img_by_stem
-        s = stem(name)
+            s = stem(name)
         if s in table:
             raise ValueError(
                 f"{path}: duplicate stem {s!r} ({table[s]} vs {full}) — "
@@ -454,17 +453,171 @@ def _paired_files(path: str) -> Tuple[dict, dict]:
     if not img_by_stem or unmatched:
         raise ValueError(
             f"{path}: every image needs a .npy mask with the same stem "
-            f"(modulo _mask/_label/_gt suffixes); unmatched stems: "
+            f"(modulo _mask/_label/_gt suffixes; note *_img.npy files are "
+            f"treated as ARRAY IMAGES, the prepare_* --format npy "
+            f"convention); unmatched stems: "
             f"{unmatched[:10]}"
         )
     return img_by_stem, npy_by_stem
+
+
+def _read_tile(
+    img_path: str,
+    npy_path: str,
+    image_size: Optional[Tuple[int, int]],
+    normalize: bool,
+    channels: int = 3,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """One (image, mask) pair from disk — the shared read used by both the
+    eager and lazy tile datasets so their preprocessing cannot drift."""
+    # int32 BEFORE padding: on a uint8 mask the -1 void pad would wrap
+    # to 255 and silently train as the last class.
+    lab = np.load(npy_path).astype(np.int32)
+    size = tuple(image_size) if image_size is not None else lab.shape[:2]
+    if img_path.endswith(".npy"):
+        # Array-format tile (prepare_* --format npy): decode-free read.
+        # Mirror load_image_file exactly — dtype guard, channel repeat/
+        # truncate, crop/pad, f32/255 — so png and npy tiles of the same
+        # source cannot drift.
+        img = np.load(img_path)
+        if img.dtype != np.uint8:
+            raise ValueError(
+                f"{img_path}: array tiles must be uint8 raw imagery (the "
+                f"prepare_* converters write uint8; a float array here "
+                f"would be silently re-divided by 255), got {img.dtype}"
+            )
+        if img.ndim == 2:
+            img = img[..., None]
+        if img.shape[-1] < channels:
+            img = np.repeat(img[..., :1], channels, axis=-1)
+        elif img.shape[-1] > channels:
+            img = img[..., :channels]
+        img = img[: size[0], : size[1]]
+        if img.shape[:2] != size:
+            img = np.pad(
+                img,
+                ((0, size[0] - img.shape[0]), (0, size[1] - img.shape[1]),
+                 (0, 0)),
+            )
+        img = img.astype(np.float32)
+        if normalize:
+            img /= 255.0
+    else:
+        img = load_image_file(
+            img_path, size, channels=channels, normalize=normalize
+        )
+    lab = lab[: size[0], : size[1]]
+    if lab.shape != size:
+        # Void (-1), not class 0: padded pixels must not train or score
+        # as the first class (the loss/metrics/confusion paths all
+        # ignore -1).
+        lab = np.pad(
+            lab,
+            ((0, size[0] - lab.shape[0]), (0, size[1] - lab.shape[1])),
+            constant_values=-1,
+        )
+    return img, lab
+
+
+class LazyTileDataset:
+    """Fixed-tile dataset that reads tiles from disk per ``gather()``.
+
+    The eager :func:`load_tile_dir` stacks every tile resident — ~20 GB for
+    full Cityscapes at 512×1024 — which the reference's design forces
+    (кластер.py:660-674) but nothing in this framework needs: the
+    ShardedLoader's only access point is ``gather(indices)``, and its
+    prefetch thread overlaps these reads with device compute.  Use
+    ``prepare_*  --format npy`` tiles for decode-free reads.
+
+    No ``.images``/``.labels`` arrays exist by construction; paths that
+    need resident arrays (``DeviceCachedLoader``, prediction dumps) must
+    use the eager loader — attribute access raises with that instruction.
+    """
+
+    def __init__(
+        self,
+        pairs: "list[Tuple[str, str]]",
+        image_size: Optional[Tuple[int, int]] = None,
+        normalize: bool = True,
+        channels: int = 3,
+    ):
+        if not pairs:
+            raise ValueError("LazyTileDataset needs at least one tile")
+        self.pairs = list(pairs)
+        self.image_size = tuple(image_size) if image_size else None
+        self.normalize = normalize
+        self.channels = channels
+        img0, lab0 = _read_tile(
+            *self.pairs[0], self.image_size, normalize, channels
+        )
+        self._shape = img0.shape
+
+    def __len__(self) -> int:
+        return len(self.pairs)
+
+    def gather(self, indices: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        idx = np.asarray(indices, np.int64)
+        imgs = np.empty((len(idx), *self._shape), np.float32)
+        labs = np.empty((len(idx), *self._shape[:2]), np.int32)
+        for out, i in enumerate(idx):
+            img, lab = _read_tile(
+                *self.pairs[i], self.image_size, self.normalize, self.channels
+            )
+            if img.shape != self._shape:
+                raise ValueError(
+                    f"tile {self.pairs[i][0]}: shape {img.shape} != first "
+                    f"tile {self._shape}; pass image_size to unify"
+                )
+            imgs[out] = img
+            labs[out] = lab
+        return imgs, labs
+
+    def set_epoch(self, epoch: int) -> None:
+        """Fixed tiles: nothing epoch-dependent."""
+
+    @property
+    def image_shape(self) -> Tuple[int, int, int]:
+        return self._shape  # type: ignore[return-value]
+
+    def subset(self, start: int, stop: int) -> "LazyTileDataset":
+        """File-list slice (train/test split without touching pixel data)."""
+        ds = object.__new__(LazyTileDataset)
+        ds.pairs = self.pairs[start:stop]
+        if not ds.pairs:
+            raise ValueError(f"empty subset [{start}:{stop}]")
+        ds.image_size = self.image_size
+        ds.normalize = self.normalize
+        ds.channels = self.channels
+        ds._shape = self._shape
+        return ds
+
+    def materialize(self) -> TileDataset:
+        """Eager-load every tile (small splits, e.g. the eval holdout)."""
+        imgs, labs = self.gather(np.arange(len(self)))
+        return TileDataset(imgs, labs)
+
+    def __getattr__(self, name):
+        if name in ("images", "labels"):
+            raise AttributeError(
+                f"LazyTileDataset has no resident '{name}' array; use the "
+                f"eager load_tile_dir (or .materialize()) for paths that "
+                f"need whole-dataset arrays (device_cache, dumps)"
+            )
+        raise AttributeError(name)
+
+
+def tile_dir_pairs(path: str) -> "list[Tuple[str, str]]":
+    """Sorted (image_path, mask_path) pairs for a tile directory."""
+    img_by_stem, npy_by_stem = _paired_files(path)
+    return [(img_by_stem[s], npy_by_stem[s]) for s in sorted(img_by_stem)]
 
 
 def load_tile_dir(
     path: str,
     image_size: Optional[Tuple[int, int]] = None,
     normalize: bool = True,
-) -> TileDataset:
+    lazy: bool = False,
+) -> "TileDataset | LazyTileDataset":
     """Read one directory of image files + ``.npy`` masks (кластер.py:660-674).
 
     Pairing is strict by filename stem (modulo ``_mask``/``_label``/``_gt``
@@ -473,41 +626,39 @@ def load_tile_dir(
     kinds' sort orders diverge (e.g. unpadded ``tile_10`` vs ``tile_2``).
     Images are cropped/truncated to ``image_size`` the way the reference
     crops ``[:512, :512]`` (кластер.py:822).
+
+    ``lazy=True`` returns a :class:`LazyTileDataset` that reads tiles per
+    gather instead of stacking the whole directory resident — the
+    full-Cityscapes-volume path (``DataConfig.lazy_tiles``).
     """
-    img_by_stem, npy_by_stem = _paired_files(path)
+    pairs = tile_dir_pairs(path)
+    if lazy:
+        return LazyTileDataset(pairs, image_size, normalize)
     images, labels = [], []
-    for s in sorted(img_by_stem):
-        # int32 BEFORE padding: on a uint8 mask the -1 void pad would wrap
-        # to 255 and silently train as the last class.
-        lab = np.load(npy_by_stem[s]).astype(np.int32)
-        size = tuple(image_size) if image_size is not None else lab.shape[:2]
-        images.append(load_image_file(img_by_stem[s], size, normalize=normalize))
-        lab = lab[: size[0], : size[1]]
-        if lab.shape != size:
-            # Void (-1), not class 0: padded pixels must not train or score
-            # as the first class (the loss/metrics/confusion paths all
-            # ignore -1).
-            lab = np.pad(
-                lab,
-                ((0, size[0] - lab.shape[0]), (0, size[1] - lab.shape[1])),
-                constant_values=-1,
-            )
+    for img_path, npy_path in pairs:
+        img, lab = _read_tile(img_path, npy_path, image_size, normalize)
+        images.append(img)
         labels.append(lab)
     return TileDataset(np.stack(images), np.stack(labels).astype(np.int32))
 
 
-def train_test_split(
-    ds: TileDataset, test_split: int
-) -> Tuple[TileDataset, TileDataset]:
-    """Last-N holdout, reference behavior (кластер.py:672-673)."""
-    n = len(ds)
+def last_n_split_point(n: int, test_split: int) -> int:
+    """Validated cut index for the last-N holdout (кластер.py:672-673) —
+    one source of truth for both the eager and lazy split paths."""
     k = max(test_split, 0)
     if k >= n:
         raise ValueError(
             f"test_split={test_split} would leave no training tiles "
             f"(dataset has {n}); lower DataConfig.test_split or add data"
         )
-    cut = n - k
+    return n - k
+
+
+def train_test_split(
+    ds: TileDataset, test_split: int
+) -> Tuple[TileDataset, TileDataset]:
+    """Last-N holdout, reference behavior (кластер.py:672-673)."""
+    cut = last_n_split_point(len(ds), test_split)
     return (
         TileDataset(ds.images[:cut], ds.labels[:cut]),
         TileDataset(ds.images[cut:], ds.labels[cut:]),
@@ -748,6 +899,11 @@ def build_dataset(cfg: DataConfig):
             "(data_dir set and crops_per_epoch > 0); fixed-tile and "
             "synthetic datasets are loaded eagerly"
         )
+    if cfg.lazy_tiles and cfg.crops_per_epoch > 0:
+        raise ValueError(
+            "lazy_tiles is a fixed-tile-mode option; crop mode over large "
+            "scenes wants mmap_scenes instead"
+        )
     if cfg.crops_per_epoch > 0:
         scenes = (
             load_scene_dir(cfg.data_dir, mmap=cfg.mmap_scenes)
@@ -780,6 +936,30 @@ def build_dataset(cfg: DataConfig):
                 np.zeros((0, *cfg.image_size, channels), np.float32),
                 np.zeros((0, *cfg.image_size), np.int32),
             )
+        return train, test
+    if cfg.lazy_tiles:
+        if not cfg.data_dir:
+            raise ValueError(
+                "lazy_tiles reads tiles from disk per gather — it needs "
+                "data_dir (synthetic datasets are generated resident)"
+            )
+        lazy = load_tile_dir(
+            cfg.data_dir, image_size=tuple(cfg.image_size), lazy=True
+        )
+        cut = last_n_split_point(len(lazy), cfg.test_split)
+        train = lazy.subset(0, cut)
+        # The holdout is small by design (reference: last 30 tiles) and the
+        # eval/dump paths need resident arrays — materialize it.
+        test = (
+            lazy.subset(cut, len(lazy)).materialize()
+            if cut < len(lazy) else
+            TileDataset(
+                np.zeros((0, *lazy.image_shape), np.float32),
+                np.zeros((0, *lazy.image_shape[:2]), np.int32),
+            )
+        )
+        if cfg.augment:
+            train = DihedralAugment(train, seed=cfg.seed)
         return train, test
     if cfg.data_dir:
         ds = load_tile_dir(cfg.data_dir, image_size=tuple(cfg.image_size))

@@ -80,6 +80,12 @@ class Trainer:
                 f"data.compact_upload ships int8 labels, which cannot hold "
                 f"num_classes={cfg.data.num_classes} (max 127)"
             )
+        if cfg.data.lazy_tiles and cfg.data.device_cache:
+            raise ValueError(
+                "data.lazy_tiles and data.device_cache are mutually "
+                "exclusive: the device cache uploads whole resident arrays, "
+                "exactly what lazy_tiles exists to avoid"
+            )
         if cfg.data.compact_upload and cfg.data.device_cache:
             raise ValueError(
                 "data.compact_upload only affects the ShardedLoader host-"

@@ -59,7 +59,8 @@ def labelids_to_trainids(label_ids: np.ndarray) -> np.ndarray:
 
 
 def convert_split(
-    root: str, split: str, out_dir: str, downscale: int = 1, limit: int = 0
+    root: str, split: str, out_dir: str, downscale: int = 1, limit: int = 0,
+    fmt: str = "png",
 ) -> int:
     from PIL import Image
 
@@ -87,7 +88,16 @@ def convert_split(
                 img = img.resize((w // downscale, h // downscale), Image.BILINEAR)
                 # NEAREST for masks: interpolating label ids invents classes.
                 mask = mask.resize((w // downscale, h // downscale), Image.NEAREST)
-            img.save(os.path.join(out_dir, f"{stem}.png"))
+            if fmt == "npy":
+                # Array-format tiles: uint8 <stem>_img.npy — decode-free
+                # per-tile reads for load_tile_dir(lazy=True) at
+                # full-Cityscapes volume (2975 tiles ≈ 20 GB eager).
+                np.save(
+                    os.path.join(out_dir, f"{stem}_img.npy"),
+                    np.ascontiguousarray(np.asarray(img, np.uint8)),
+                )
+            else:
+                img.save(os.path.join(out_dir, f"{stem}.png"))
             np.save(
                 os.path.join(out_dir, f"{stem}.npy"),
                 labelids_to_trainids(np.asarray(mask)),
@@ -105,8 +115,16 @@ def main() -> None:
     p.add_argument("--out", required=True, help="output tile directory")
     p.add_argument("--downscale", type=int, default=2)
     p.add_argument("--limit", type=int, default=0, help="stop after N frames")
+    p.add_argument(
+        "--format", default="png", choices=["png", "npy"], dest="fmt",
+        help="npy writes uint8 <stem>_img.npy tiles for decode-free "
+             "load_tile_dir(lazy=True) reads",
+    )
     args = p.parse_args()
-    n = convert_split(args.root, args.split, args.out, args.downscale, args.limit)
+    n = convert_split(
+        args.root, args.split, args.out, args.downscale, args.limit,
+        fmt=args.fmt,
+    )
     print(f"wrote {n} (image, trainId-mask) pairs to {args.out}")
 
 

@@ -632,3 +632,85 @@ def test_mmap_scenes_config_validation_and_grid_tiles(tmp_path):
     tiles_u8 = grid_tiles([(u8, lab)], (8, 8))
     tiles_f32 = grid_tiles([(f32, lab)], (8, 8))
     np.testing.assert_array_equal(tiles_u8.images, tiles_f32.images)
+
+
+def _write_tile_dir(path, n=6, hw=(16, 16), fmt="png"):
+    import os
+
+    import imageio.v2 as imageio
+
+    os.makedirs(path, exist_ok=True)
+    rng = np.random.default_rng(11)
+    for i in range(n):
+        img = rng.integers(0, 255, (*hw, 3), dtype=np.uint8)
+        if fmt == "npy":
+            np.save(os.path.join(path, f"tile_{i:02d}_img.npy"), img)
+        else:
+            imageio.imwrite(os.path.join(path, f"tile_{i:02d}.png"), img)
+        np.save(
+            os.path.join(path, f"tile_{i:02d}.npy"),
+            rng.integers(0, 6, hw).astype(np.int32),
+        )
+
+
+@pytest.mark.parametrize("fmt", ["png", "npy"])
+def test_lazy_tile_dir_matches_eager(tmp_path, fmt):
+    """load_tile_dir(lazy=True) must serve byte-identical tiles to the
+    eager stack — only residency differs (shared _read_tile)."""
+    d = str(tmp_path / fmt)
+    _write_tile_dir(d, fmt=fmt)
+    eager = load_tile_dir(d)
+    lazy = load_tile_dir(d, lazy=True)
+    assert len(eager) == len(lazy) == 6
+    assert eager.image_shape == lazy.image_shape
+    idx = np.array([4, 0, 2])
+    xe, ye = eager.gather(idx)
+    xl, yl = lazy.gather(idx)
+    np.testing.assert_array_equal(xe, xl)
+    np.testing.assert_array_equal(ye, yl)
+    # Split equivalence: file-list subset == array slice; materialize()
+    # round-trips to a plain TileDataset.
+    tr_e, te_e = train_test_split(eager, 2)
+    tr_l = lazy.subset(0, 4)
+    te_l = lazy.subset(4, 6).materialize()
+    np.testing.assert_array_equal(
+        tr_e.gather(np.arange(4))[0], tr_l.gather(np.arange(4))[0]
+    )
+    np.testing.assert_array_equal(te_e.images, te_l.images)
+    np.testing.assert_array_equal(te_e.labels, te_l.labels)
+    with pytest.raises(AttributeError, match="materialize"):
+        _ = lazy.images
+
+
+def test_lazy_tiles_build_dataset_and_loader(tmp_path, mesh):
+    """DataConfig.lazy_tiles: lazy train split, eager eval holdout, and the
+    ShardedLoader feeds from it; device_cache combination rejected."""
+    from ddlpc_tpu.data import LazyTileDataset
+
+    d = str(tmp_path / "tiles")
+    _write_tile_dir(d, n=10, fmt="npy")
+    cfg = DataConfig(
+        data_dir=d, dataset="synthetic", image_size=(16, 16), num_classes=6,
+        test_split=2, lazy_tiles=True,
+    )
+    train, test = build_dataset(cfg)
+    assert isinstance(train, LazyTileDataset) and len(train) == 8
+    assert isinstance(test, TileDataset) and len(test) == 2
+    loader = ShardedLoader(
+        train, mesh, global_micro_batch=8, sync_period=1, seed=1
+    )
+    imgs, labs = next(iter(loader))
+    assert imgs.shape == (1, 8, 16, 16, 3)
+    assert float(np.max(np.asarray(imgs))) <= 1.0
+
+    with pytest.raises(ValueError, match="lazy_tiles"):
+        build_dataset(
+            DataConfig(dataset="synthetic", lazy_tiles=True)
+        )
+    with pytest.raises(ValueError, match="lazy_tiles"):
+        build_dataset(
+            DataConfig(
+                data_dir=d, dataset="synthetic", lazy_tiles=True,
+                crops_per_epoch=4,
+            )
+        )
