@@ -72,22 +72,16 @@ class TileDataset:
         return tuple(self.images.shape[1:])  # type: ignore[return-value]
 
 
-def load_image_file(
-    path: str,
+def _finish_image(
+    img: np.ndarray,
     image_size: Optional[Tuple[int, int]],
-    channels: int = 3,
-    normalize: bool = True,
+    channels: int,
+    normalize: bool,
 ) -> np.ndarray:
-    """One image file → [H, W, channels] float array.
-
-    ``image_size`` set: crops larger inputs (the reference's ``[:512,:512]``,
-    кластер.py:822) and zero-pads smaller ones to exactly that size;
-    ``image_size=None``: native size.  Repeats grayscale / drops alpha to
-    reach ``channels``.  Shared by the tile reader, the scene reader, and
-    the predict CLI so their preprocessing cannot drift."""
-    import imageio.v2 as imageio
-
-    img = np.asarray(imageio.imread(path))
+    """Post-decode pipeline shared by every image source (file decode and
+    array tiles): ndim fixup, channel repeat/truncate, crop/zero-pad to
+    ``image_size``, float32, /255 — ONE implementation so png and npy
+    forms of the same source cannot drift."""
     if img.ndim == 2:
         img = img[..., None]
     if img.shape[-1] < channels:
@@ -104,6 +98,26 @@ def load_image_file(
     if normalize:
         img /= 255.0  # кластер.py:737
     return img
+
+
+def load_image_file(
+    path: str,
+    image_size: Optional[Tuple[int, int]],
+    channels: int = 3,
+    normalize: bool = True,
+) -> np.ndarray:
+    """One image file → [H, W, channels] float array.
+
+    ``image_size`` set: crops larger inputs (the reference's ``[:512,:512]``,
+    кластер.py:822) and zero-pads smaller ones to exactly that size;
+    ``image_size=None``: native size.  Repeats grayscale / drops alpha to
+    reach ``channels``.  Shared by the tile reader, the scene reader, and
+    the predict CLI so their preprocessing cannot drift."""
+    import imageio.v2 as imageio
+
+    return _finish_image(
+        np.asarray(imageio.imread(path)), image_size, channels, normalize
+    )
 
 
 class CropDataset:
@@ -434,7 +448,9 @@ def _paired_files(path: str) -> Tuple[dict, dict]:
         # ordinary files whose names end in _img keep their stems).
         if name.endswith("_img.npy"):
             table = img_by_stem
-            s = stem(name.removesuffix("_img.npy"))
+            # Re-attach an extension before file_stem so dotted stems
+            # ("scene.v2_img.npy") don't get a second extension-strip.
+            s = stem(name[: -len("_img.npy")] + ".npy")
         elif name.endswith(".npy"):
             table = npy_by_stem
             s = stem(name)
@@ -475,10 +491,8 @@ def _read_tile(
     lab = np.load(npy_path).astype(np.int32)
     size = tuple(image_size) if image_size is not None else lab.shape[:2]
     if img_path.endswith(".npy"):
-        # Array-format tile (prepare_* --format npy): decode-free read.
-        # Mirror load_image_file exactly — dtype guard, channel repeat/
-        # truncate, crop/pad, f32/255 — so png and npy tiles of the same
-        # source cannot drift.
+        # Array-format tile (prepare_* --format npy): decode-free read,
+        # then the same shared post-decode pipeline as file decode.
         img = np.load(img_path)
         if img.dtype != np.uint8:
             raise ValueError(
@@ -486,22 +500,7 @@ def _read_tile(
                 f"prepare_* converters write uint8; a float array here "
                 f"would be silently re-divided by 255), got {img.dtype}"
             )
-        if img.ndim == 2:
-            img = img[..., None]
-        if img.shape[-1] < channels:
-            img = np.repeat(img[..., :1], channels, axis=-1)
-        elif img.shape[-1] > channels:
-            img = img[..., :channels]
-        img = img[: size[0], : size[1]]
-        if img.shape[:2] != size:
-            img = np.pad(
-                img,
-                ((0, size[0] - img.shape[0]), (0, size[1] - img.shape[1]),
-                 (0, 0)),
-            )
-        img = img.astype(np.float32)
-        if normalize:
-            img /= 255.0
+        img = _finish_image(img, size, channels, normalize)
     else:
         img = load_image_file(
             img_path, size, channels=channels, normalize=normalize

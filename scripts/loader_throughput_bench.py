@@ -51,6 +51,11 @@ def main() -> None:
                    help="bf16 images + int8 labels on the wire "
                         "(ShardedLoader(compact=True), bit-identical for "
                         "bf16-compute models)")
+    p.add_argument("--source", default="memory",
+                   choices=["memory", "lazy-npy", "lazy-png"],
+                   help="memory: resident SyntheticTiles; lazy-*: a "
+                        "LazyTileDataset over a generated tile dir "
+                        "(per-gather disk reads; npy = decode-free)")
     p.add_argument("--out", default="docs/disk_fit/loader_throughput.json")
     args = p.parse_args()
 
@@ -63,13 +68,35 @@ def main() -> None:
     import numpy as np
 
     from ddlpc_tpu.config import ParallelConfig
-    from ddlpc_tpu.data.datasets import SyntheticTiles
+    from ddlpc_tpu.data.datasets import SyntheticTiles, load_tile_dir
     from ddlpc_tpu.data.loader import ShardedLoader
     from ddlpc_tpu.parallel.mesh import make_mesh
 
     ds = SyntheticTiles(
         num_tiles=args.tiles, image_size=(args.size, args.size)
     )
+    tmp_ctx = None
+    if args.source != "memory":
+        # Write the same tiles to disk once, then measure the lazy path's
+        # per-gather reads (npy = decode-free uint8 arrays; png = decode).
+        import tempfile
+
+        import imageio.v2 as imageio
+
+        tmp_ctx = tempfile.TemporaryDirectory(prefix="lazy_tiles_")
+        for i in range(len(ds)):
+            u8 = (ds.images[i] * 255).astype(np.uint8)
+            if args.source == "lazy-npy":
+                np.save(os.path.join(tmp_ctx.name, f"t{i:04d}_img.npy"), u8)
+            else:
+                imageio.imwrite(
+                    os.path.join(tmp_ctx.name, f"t{i:04d}.png"), u8
+                )
+            np.save(
+                os.path.join(tmp_ctx.name, f"t{i:04d}.npy"),
+                ds.labels[i].astype(np.int32),
+            )
+        ds = load_tile_dir(tmp_ctx.name, lazy=True)
     mesh = make_mesh(ParallelConfig())
     loader = ShardedLoader(
         ds, mesh, global_micro_batch=args.micro_batch,
@@ -85,6 +112,7 @@ def main() -> None:
         "micro_batch": args.micro_batch, "sync_period": args.sync,
         "epochs": args.epochs,
         "compact": args.compact,
+        "source": args.source,
         "mb_per_tile": round(bytes_per_tile / 2**20, 3),
     }
 
@@ -122,7 +150,9 @@ def main() -> None:
 
     key = f"{rec['backend']}_{args.size}px_b{args.micro_batch}x{args.sync}" + (
         "_compact" if args.compact else ""
-    )
+    ) + ("" if args.source == "memory" else f"_{args.source}")
+    if tmp_ctx is not None:
+        tmp_ctx.cleanup()
     merged = {}
     if os.path.exists(args.out):
         merged = json.load(open(args.out))

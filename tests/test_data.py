@@ -714,3 +714,19 @@ def test_lazy_tiles_build_dataset_and_loader(tmp_path, mesh):
                 crops_per_epoch=4,
             )
         )
+
+
+def test_img_npy_pairing_with_dotted_stems(tmp_path):
+    """*_img.npy stem derivation must survive dots in the stem (review
+    find: removesuffix left an extension-less name that file_stem
+    double-stripped)."""
+    import os
+
+    from ddlpc_tpu.data.datasets import _paired_files
+
+    d = str(tmp_path)
+    np.save(os.path.join(d, "scene.v2_img.npy"),
+            np.zeros((8, 8, 3), np.uint8))
+    np.save(os.path.join(d, "scene.v2.npy"), np.zeros((8, 8), np.int32))
+    imgs, masks = _paired_files(d)
+    assert set(imgs) == set(masks) == {"scene.v2"}
