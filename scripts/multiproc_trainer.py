@@ -28,7 +28,13 @@ N=2 and at fixed tiles"):
   augmentation draws shared across processes) — the host gather path a pod
   would run for scene-sized imagery.
 
-Usage: python scripts/multiproc_trainer.py [--procs 4] [--crops]
+Round-5 extension:
+- ``--mode lazy`` feeds every rank from ONE shared npy tile directory via
+  ``DataConfig.lazy_tiles`` (per-gather disk reads) shipped compact
+  (``compact_upload``, bf16+int8) — the round-5 host paths under the same
+  disjointness / replicated-state / synchronized-resume proof.
+
+Usage: python scripts/multiproc_trainer.py [--procs 4] [--crops | --mode lazy]
 """
 
 from __future__ import annotations
@@ -40,7 +46,7 @@ import tempfile
 import time
 
 
-def child(rank: int, port: int, workdir: str, procs: int, crops: bool) -> None:
+def child(rank: int, port: int, workdir: str, procs: int, mode: str) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -76,7 +82,21 @@ def child(rank: int, port: int, workdir: str, procs: int, crops: bool) -> None:
     from ddlpc_tpu.train.trainer import Trainer
 
     n_dev = procs * local_devices
-    if crops:
+    crops = mode == "crops"
+    if mode == "lazy":
+        # Round-5 features under a REAL multi-process topology: every rank
+        # lazily reads its disjoint shard from the SAME npy tile dir
+        # (written once by the parent) and ships it compact (bf16+int8).
+        data = DataConfig(
+            data_dir=os.path.join(workdir, "tiles"),
+            dataset="synthetic",
+            image_size=(32, 32),
+            test_split=8,
+            num_classes=3,
+            lazy_tiles=True,
+            compact_upload=True,
+        )
+    elif crops:
         # Scene crops + dihedral augmentation: the host gather path.
         # 32 crops/epoch = 2 super-batches of 16, no wrap-fill.
         data = DataConfig(
@@ -190,7 +210,7 @@ def child(rank: int, port: int, workdir: str, procs: int, crops: bool) -> None:
 
     print(
         f"[rank {rank}/{procs}] trainer-e2e OK "
-        f"(crops={crops}, epochs resumed at {resumed.start_epoch})",
+        f"(mode={mode}, epochs resumed at {resumed.start_epoch})",
         flush=True,
     )
 
@@ -207,14 +227,33 @@ def main() -> int:
         "the proof's SPMD program intact",
     )
     p.add_argument("--crops", action="store_true")
+    p.add_argument(
+        "--mode", default="", choices=("", "tiles", "crops", "lazy"),
+        help="lazy: npy tile dir read via lazy_tiles + compact_upload "
+        "(round-5 host paths) under the same disjointness/resume proof",
+    )
     p.add_argument("--timeout", type=float, default=900.0)
     args = p.parse_args()
+    if args.mode and args.crops and args.mode != "crops":
+        p.error(f"--crops conflicts with --mode {args.mode}")
+    mode = args.mode or ("crops" if args.crops else "tiles")
 
     sock = socket.socket()
     sock.bind(("127.0.0.1", 0))
     port = sock.getsockname()[1]
     sock.close()
     workdir = tempfile.mkdtemp(prefix="mp_trainer_")
+    if mode == "lazy":
+        import numpy as np
+
+        tiles = os.path.join(workdir, "tiles")
+        os.makedirs(tiles)
+        rng = np.random.default_rng(0)
+        for i in range(24):
+            img = rng.integers(0, 255, (32, 32, 3), dtype=np.uint8)
+            lab = (img.mean(-1) / 256.0 * 3).astype(np.int32)
+            np.save(os.path.join(tiles, f"t{i:02d}_img.npy"), img)
+            np.save(os.path.join(tiles, f"t{i:02d}.npy"), lab)
     procs = [
         subprocess.Popen(
             [
@@ -225,7 +264,7 @@ def main() -> int:
                 str(port),
                 workdir,
                 str(args.procs),
-                "1" if args.crops else "0",
+                mode,
             ]
         )
         for r in range(args.procs)
@@ -243,7 +282,7 @@ def main() -> int:
     if any(rcs):
         print(f"FAILED: exit codes {rcs}", file=sys.stderr)
         return 1
-    print(f"multiproc trainer OK (procs={args.procs}, crops={args.crops})")
+    print(f"multiproc trainer OK (procs={args.procs}, mode={mode})")
     return 0
 
 
@@ -255,7 +294,7 @@ if __name__ == "__main__":
             int(sys.argv[i + 2]),
             sys.argv[i + 3],
             int(sys.argv[i + 4]),
-            sys.argv[i + 5] == "1",
+            sys.argv[i + 5],
         )
     else:
         sys.exit(main())

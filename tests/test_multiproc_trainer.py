@@ -69,4 +69,22 @@ def test_multiprocess_crop_augment_pipeline():
         timeout=540,  # > the script's 450s deadline (see above)
     )
     assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
-    assert "crops=True" in proc.stdout
+    assert "mode=crops" in proc.stdout
+
+
+def test_multiprocess_lazy_compact_pipeline():
+    """Round-5 host paths under a real multi-process topology: every rank
+    lazily reads its disjoint shard from one npy tile dir
+    (DataConfig.lazy_tiles) and ships it compact (compact_upload), with
+    the same disjointness / replicated-state / synchronized-resume proof."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--mode", "lazy", "--timeout", "480"],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=540,
+    )
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    assert "multiproc trainer OK (procs=2, mode=lazy)" in proc.stdout
