@@ -379,24 +379,10 @@ def main() -> None:
         # Deadline-bounded backend probe: a wedged device tunnel blocks
         # jax.devices() FOREVER (observed mid-round-4); an explicit error
         # line beats an infinite hang for any harness driving this.
-        import threading
+        from ddlpc_tpu.utils.backend_probe import probe_backend
 
-        probed: list = []
-
-        def _probe():
-            try:
-                probed.append(jax.devices())
-            except Exception as e:
-                probed.append(e)
-
-        t = threading.Thread(target=_probe, daemon=True)
-        t.start()
-        t.join(300.0)
-        if not probed:
-            # The probe is advisory: the daemon thread may finish init just
-            # after the deadline — one last look before declaring it dead.
-            t.join(5.0)
-        if not probed or isinstance(probed[0], Exception):
+        result = probe_backend(300.0)
+        if result is None or isinstance(result, Exception):
             requested = "all_zoo" if args.all else HEADLINE
             print(
                 json.dumps(
@@ -406,8 +392,9 @@ def main() -> None:
                         "unit": "tiles/s/chip",
                         "vs_baseline": None,
                         "error": (
-                            "backend init timed out/failed — device tunnel "
-                            f"unreachable ({probed[0]!r})" if probed else
+                            "backend init failed — device tunnel "
+                            f"unreachable ({result!r})"
+                            if result is not None else
                             "backend init timed out after 300 s — device "
                             "tunnel unreachable"
                         ),

@@ -1,0 +1,45 @@
+"""Deadline-bounded backend probe — ONE implementation for every harness.
+
+A wedged device tunnel (observed rounds 4-5: the axon relay dies and every
+subsequent ``jax.devices()`` blocks FOREVER) must never hang a harness
+silently.  bench.py, ``__graft_entry__.entry()`` and
+``__graft_entry__.dryrun_multichip()`` all need the same probe with
+different deadlines and different failure policies (error line / raise /
+virtual-CPU fallback); they share this helper so deadline and grace
+tuning happen in one place.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence, Union
+
+import jax
+
+ProbeResult = Union[Sequence[jax.Device], Exception, None]
+
+
+def probe_backend(deadline_s: float, grace_s: float = 5.0) -> ProbeResult:
+    """``jax.devices()`` with a deadline, off-thread.
+
+    Returns the device list on success, the raised ``Exception`` on init
+    failure, or ``None`` if init was still blocked after ``deadline_s``
+    (+ one ``grace_s`` re-check, because the daemon thread may finish init
+    just after the deadline — the probe is advisory, not a cancellation).
+    The probing thread is a daemon: a hung init cannot keep the process
+    alive, but it may complete concurrently after this returns.
+    """
+    probed: list = []
+
+    def _probe() -> None:
+        try:
+            probed.append(jax.devices())
+        except Exception as e:  # noqa: BLE001 — callers choose the policy
+            probed.append(e)
+
+    t = threading.Thread(target=_probe, daemon=True)
+    t.start()
+    t.join(deadline_s)
+    if not probed and grace_s > 0:
+        t.join(grace_s)
+    return probed[0] if probed else None
