@@ -12,7 +12,7 @@ tuning happen in one place.
 from __future__ import annotations
 
 import threading
-from typing import Optional, Sequence, Union
+from typing import Sequence, Union
 
 import jax
 

@@ -1,0 +1,51 @@
+#!/bin/bash
+# Round-5 unified TPU queue, ordered by VALUE PER MINUTE so a tunnel that
+# returns late in the round still lands the most important artifacts
+# before time runs out (the original run_tpu_backlog.sh put the 2 h pod
+# LR sweep first, which would starve everything else).  Replaces both
+# run_tpu_backlog.sh and run_tpu_backlog2.sh — kill their pollers before
+# starting this one.  Every harness is idempotent (merge-by-tag /
+# per-row incremental writes), so partial drains are safe and re-runs
+# resume.
+#
+#   nohup scripts/run_tpu_backlog_v2.sh > /tmp/tpu_backlog_v2.log 2>&1 &
+#
+# Order rationale (VERDICT r4 "Next round" numbering):
+#   1-2. post-fusion headline + zoo re-bench (#1a: BENCH must be non-null;
+#        headline alone is ~10 min)
+#   3.   post-fuse xplane trace (#1e, 15 min: attribution for PERF.md)
+#   4.   jax 512² parity arm (#4, ~15-40 min: completes the pair against
+#        the committed torch anchor 0.9787)
+#   5-6. head_bench + zoo_variants (#1a tail: fused-loss candidate grid)
+#   7.   unetpp scope quality A/B (#1c / weak #6)
+#   8.   pod1024 LR curves (#1b / #2: the PENDING configs' evidence;
+#        longest, so last)
+#   9.   seed_spread (#3/#8: error bars; flagship group first - it also
+#        audits the shipped codec choice)
+set -u
+export PYTHONPATH=/root/repo:/root/.axon_site
+cd /root/repo
+# Self-enforce the single-queue precondition: retire the superseded
+# pollers so three queues can never drive the one chip concurrently.
+# (The patterns cannot match this script's own _v2 name.)
+pkill -f 'run_tpu_backlog\.sh' 2>/dev/null
+pkill -f 'run_tpu_backlog2\.sh' 2>/dev/null
+for i in $(seq 1 400); do
+  if timeout 90 python -c "import jax; assert jax.devices()" > /dev/null 2>&1; then
+    echo "TUNNEL UP after $i polls $(date)"
+    break
+  fi
+  sleep 60
+done
+timeout 90 python -c "import jax; assert jax.devices()" || { echo "TUNNEL NEVER RECOVERED"; exit 1; }
+echo "=== bench headline ===";  timeout 1800 python bench.py
+echo "=== bench all ===";       timeout 3600 python bench.py --all
+echo "=== trace ===";           timeout 900  python scripts/trace_step.py --tag plain_grouped
+echo "=== parity jax 512 ==="; timeout 3600 python scripts/torch_parity.py --size 512 --epochs 15 --seeds 0 --dataset synthetic_hard --arms jax --out docs/parity/summary_hard_512.json
+echo "=== head_bench ===";      timeout 2400 python scripts/head_bench.py
+echo "=== zoo_variants ===";    timeout 1200 python scripts/zoo_variants_bench.py
+echo "=== unetpp_scope ===";    timeout 3600 python scripts/unetpp_scope_ab.py
+echo "=== pod_lr_sweep ===";    timeout 7200 python scripts/pod_lr_sweep.py
+echo "=== seed_spread flagship ==="; timeout 7200 python scripts/seed_spread.py --group flagship --seeds 1,2
+echo "=== seed_spread detail ===";   timeout 10800 python scripts/seed_spread.py --group detail --seeds 1,2
+echo BACKLOG_V2_DONE
