@@ -370,25 +370,30 @@ def load_scene_dir(
     for s in sorted(img_by_stem):
         img_path = img_by_stem[s]
         if img_path.endswith(".npy"):
-            img = np.load(img_path, mmap_mode="r" if mmap else None)
-            if img.ndim == 2:
-                img = img[..., None]
-            if img.shape[-1] != channels:
-                raise ValueError(
-                    f"{img_path}: array images must have {channels} "
-                    f"channels, got shape {img.shape}"
+            if mmap:
+                # Keep the uint8 memory map untouched: consumers normalize
+                # per crop, and any repair (channel repeat, astype) would
+                # materialize exactly what mmap exists to avoid — validate
+                # strictly instead.
+                img = np.load(img_path, mmap_mode="r")
+                if img.ndim != 3 or img.shape[-1] != channels:
+                    raise ValueError(
+                        f"{img_path}: mmap images must be [H, W, "
+                        f"{channels}], got shape {img.shape}"
+                    )
+                if img.dtype != np.uint8:
+                    raise ValueError(
+                        f"{img_path}: mmap images must be uint8 (the "
+                        f"prepare_* converters write uint8; other dtypes "
+                        f"would be silently materialized and mis-scaled "
+                        f"downstream), got {img.dtype}"
+                    )
+            else:
+                # Eager array read: same shared post-decode pipeline as
+                # file decode (_finish_image), native size.
+                img = _finish_image(
+                    np.load(img_path), None, channels, normalize
                 )
-            if mmap and img.dtype != np.uint8:
-                raise ValueError(
-                    f"{img_path}: mmap images must be uint8 (the "
-                    f"prepare_* converters write uint8; other dtypes would "
-                    f"be silently materialized and mis-scaled downstream), "
-                    f"got {img.dtype}"
-                )
-            if not mmap:
-                img = img.astype(np.float32)
-                if normalize:
-                    img /= 255.0
         elif mmap:
             raise ValueError(
                 f"mmap=True needs array-format images (<stem>_img.npy), "
