@@ -389,11 +389,17 @@ def load_scene_dir(
                         f"downstream), got {img.dtype}"
                     )
             else:
-                # Eager array read: same shared post-decode pipeline as
-                # file decode (_finish_image), native size.
-                img = _finish_image(
-                    np.load(img_path), None, channels, normalize
-                )
+                # Eager array read.  npy scenes are converter-controlled
+                # (unlike decoded PNGs), so a channel mismatch is a data
+                # error in BOTH modes — validate like the mmap branch,
+                # then share the post-decode pipeline with file decode.
+                img = np.load(img_path)
+                if img.ndim != 3 or img.shape[-1] != channels:
+                    raise ValueError(
+                        f"{img_path}: array images must be [H, W, "
+                        f"{channels}], got shape {img.shape}"
+                    )
+                img = _finish_image(img, None, channels, normalize)
         elif mmap:
             raise ValueError(
                 f"mmap=True needs array-format images (<stem>_img.npy), "

@@ -1,0 +1,52 @@
+"""The shared deadline-bounded backend probe (utils/backend_probe.py).
+
+Every harness entry point (bench.py, __graft_entry__.entry,
+dryrun_multichip) depends on this helper to turn a wedged device tunnel
+(observed rounds 4-5: jax.devices() blocks forever) into a bounded,
+classifiable outcome.  Pin all three outcomes.
+"""
+
+import time
+
+import jax
+
+from ddlpc_tpu.utils import backend_probe
+
+
+def test_probe_success():
+    devices = backend_probe.probe_backend(30.0)
+    assert not isinstance(devices, Exception) and devices is not None
+    assert len(devices) >= 1  # the conftest CPU mesh
+
+
+def test_probe_hang_returns_none(monkeypatch):
+    def hang():
+        time.sleep(30.0)
+
+    monkeypatch.setattr(jax, "devices", hang)
+    t0 = time.monotonic()
+    assert backend_probe.probe_backend(0.2, grace_s=0.1) is None
+    assert time.monotonic() - t0 < 5.0  # bounded, nowhere near the sleep
+
+
+def test_probe_failure_returns_exception(monkeypatch):
+    def boom():
+        raise RuntimeError("init exploded")
+
+    monkeypatch.setattr(jax, "devices", boom)
+    result = backend_probe.probe_backend(5.0)
+    assert isinstance(result, RuntimeError)
+    assert "init exploded" in str(result)
+
+
+def test_probe_grace_catches_late_success(monkeypatch):
+    real_devices = jax.devices()
+
+    def slow():
+        time.sleep(0.5)
+        return real_devices
+
+    monkeypatch.setattr(jax, "devices", slow)
+    # Deadline misses, the grace re-check catches the late completion.
+    result = backend_probe.probe_backend(0.1, grace_s=2.0)
+    assert result == real_devices
