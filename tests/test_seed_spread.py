@@ -1,0 +1,74 @@
+"""seed_spread.py aggregation: the decision-stability logic that will
+restate the shipped tables as mean±σ (VERDICT r4 #3/#8) must itself be
+pinned — a wrong stability verdict would silently rewrite docs."""
+
+import importlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+
+def _run_aggregate(tmp_path, monkeypatch, rows, seed0=None):
+    import seed_spread
+
+    importlib.reload(seed_spread)
+    outdir = tmp_path / "seed_spread"
+    outdir.mkdir()
+    (outdir / "summary.json").write_text(json.dumps(rows))
+    monkeypatch.setattr(seed_spread, "OUTDIR", str(outdir))
+    if seed0 is not None:
+        monkeypatch.setattr(
+            seed_spread, "_committed_seed0", lambda arm: seed0.get(arm)
+        )
+    out = seed_spread.aggregate()
+    return out
+
+
+def test_aggregate_merges_committed_seed0_and_new_seeds(tmp_path, monkeypatch):
+    rows = [
+        {"tag": "detail_h16_s1", "val_miou": 0.90},
+        {"tag": "detail_h16_s2", "val_miou": 0.91},
+        {"tag": "detail_h32_s1", "val_miou": 0.912},
+        {"tag": "detail_h32_s2", "val_miou": 0.914},
+    ]
+    out = _run_aggregate(
+        tmp_path, monkeypatch, rows,
+        seed0={"detail_h16": 0.8966, "detail_h32": 0.9125},
+    )
+    h16 = out["arms"]["detail_h16"]
+    assert h16["seeds"] == [0, 1, 2] and h16["n"] == 3
+    assert abs(h16["mean"] - (0.8966 + 0.90 + 0.91) / 3) < 1e-6
+    assert h16["std"] is not None
+    # h32 − h16 mean delta ~0.010 with σ ~0.007 → NOT a stable promotion.
+    promo = out["decisions"]["h32_promotion"]
+    assert promo["stable"] is False
+
+
+def test_aggregate_flags_stable_promotion(tmp_path, monkeypatch):
+    rows = [
+        {"tag": "detail_h16_s1", "val_miou": 0.896},
+        {"tag": "detail_h16_s2", "val_miou": 0.897},
+        {"tag": "detail_h32_s1", "val_miou": 0.9120},
+        {"tag": "detail_h32_s2", "val_miou": 0.9130},
+    ]
+    out = _run_aggregate(
+        tmp_path, monkeypatch, rows,
+        seed0={"detail_h16": 0.8966, "detail_h32": 0.9125},
+    )
+    promo = out["decisions"]["h32_promotion"]
+    # delta ≈ +0.016 with σ < 0.001 → stable.
+    assert promo["stable"] is True
+
+
+def test_aggregate_orders_flagship_codecs(tmp_path, monkeypatch):
+    out = _run_aggregate(
+        tmp_path, monkeypatch, [],
+        seed0={"flagship_none": 0.922, "flagship_fp16": 0.9245,
+               "flagship_int8": 0.9394},
+    )
+    order = out["decisions"]["flagship_codec_order"]["by_mean"]
+    assert order == ["flagship_int8", "flagship_fp16", "flagship_none"]
+    # n=1 arms carry no std → no stability claim is fabricated.
+    assert out["arms"]["flagship_int8"]["std"] is None
