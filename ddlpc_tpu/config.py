@@ -144,6 +144,11 @@ class DataConfig:
     # num_classes <= 127; rejected together with device_cache (which has
     # its own compact feed, scripts/convergence_ab.py compact_batch).
     compact_upload: bool = False
+    # Host-side threads for the ShardedLoader's gather/cast/upload
+    # pipeline (SURVEY §7 hard part (c)): numpy's large copies/casts and
+    # the device upload release the GIL, so >1 scales with cores on a pod
+    # host.  Batch content and order are identical for any value.
+    loader_workers: int = 1
     # Upload the whole train set to HBM once and gather batches on device
     # (single-process, fixed-tile datasets that fit HBM — ISPRS scale is
     # ~0.5 GB).  Removes the per-epoch host→device re-upload, which on slow

@@ -196,6 +196,11 @@ class CropDataset:
     def set_epoch(self, epoch: int) -> None:
         self._epoch = int(epoch)
         self._plan = None
+        # Build eagerly: concurrent gather() calls from a multi-worker
+        # loader would otherwise each recompute the plan (deterministic, so
+        # content stays correct — but `workers` duplicate Python loops burn
+        # the cores the pool exists to recruit).
+        self._crop_plan()
 
     def _crop_plan(self) -> np.ndarray:
         """[crops_per_epoch, 3] (scene, y0, x0), deterministic per epoch."""
@@ -262,6 +267,7 @@ class DihedralAugment:
         self._epoch = int(epoch)
         self._ks = None
         self.ds.set_epoch(epoch)
+        self._epoch_ks()  # eager, same rationale as CropDataset.set_epoch
 
     @property
     def image_shape(self):

@@ -18,8 +18,8 @@ sharded over the mesh ``data`` axis (and H over ``space`` when used).
 
 from __future__ import annotations
 
-import queue
-import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator, Optional, Tuple
 
 import jax
@@ -106,6 +106,7 @@ class ShardedLoader(_EpochSampler):
         prefetch: int = 2,
         tail: str = "wrap",
         compact: bool = False,
+        workers: int = 1,
     ):
         self.ds = dataset
         self.mesh = mesh
@@ -125,6 +126,13 @@ class ShardedLoader(_EpochSampler):
         # fuse a reduction differently).  Requires labels in [-1, 127];
         # asserted per batch in the producer thread.
         self.compact = compact
+        # Host-side parallelism for gather+cast+upload (SURVEY §7 hard
+        # part (c): ≥400 tiles/s/chip needs prefetch + host parallelism).
+        # 1 keeps the single-background-thread behavior; batches stay
+        # byte-identical and ordered for any value (tests/test_data.py).
+        if workers < 1:
+            raise ValueError(f"workers must be >= 1, got {workers}")
+        self.workers = workers
         self._epoch = 0
 
         nproc = jax.process_count()
@@ -156,29 +164,38 @@ class ShardedLoader(_EpochSampler):
         self.image_spec = P(None, data_axis, space_axis)  # [A, B, H, W, C]
         self.label_spec = P(None, data_axis, space_axis)  # [A, B, H, W]
 
-    def _local_batches(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    def _super_batch_index_chunks(self) -> Iterator[np.ndarray]:
+        """This process's flat tile indices, one array per super-batch."""
         idx = self._epoch_indices()
         pid = jax.process_index()
         A, Bg, Bl = self.sync_period, self.global_micro_batch, self.local_micro_batch
         for start in range(0, len(idx) - self.super_batch + 1, self.super_batch):
             chunk = idx[start : start + self.super_batch].reshape(A, Bg)
-            local = chunk[:, pid * Bl : (pid + 1) * Bl]  # [A, B_local]
-            flat = local.reshape(-1)
-            imgs, labs = self.ds.gather(flat)
-            if self.compact:
-                # Cast on the host (producer thread — overlaps consumer
-                # compute) so the upload moves 44% of the fp32 bytes.
-                if labs.min() < -1 or labs.max() > 127:
-                    raise ValueError(
-                        f"compact=True needs labels in [-1, 127] for int8, "
-                        f"got range [{labs.min()}, {labs.max()}]"
-                    )
-                imgs = imgs.astype(ml_dtypes.bfloat16)
-                labs = labs.astype(np.int8)
-            yield (
-                imgs.reshape(A, Bl, *imgs.shape[1:]),
-                labs.reshape(A, Bl, *labs.shape[1:]),
-            )
+            yield chunk[:, pid * Bl : (pid + 1) * Bl].reshape(-1)
+
+    def _produce_host(self, flat: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """flat indices → host-side [A, B_local, ...] arrays (gather, the
+        optional compact cast, reshape) — everything except the upload."""
+        A, Bl = self.sync_period, self.local_micro_batch
+        imgs, labs = self.ds.gather(flat)
+        if self.compact:
+            # Cast on the host (worker thread — overlaps consumer compute)
+            # so the upload moves 44% of the fp32 bytes.
+            if labs.min() < -1 or labs.max() > 127:
+                raise ValueError(
+                    f"compact=True needs labels in [-1, 127] for int8, "
+                    f"got range [{labs.min()}, {labs.max()}]"
+                )
+            imgs = imgs.astype(ml_dtypes.bfloat16)
+            labs = labs.astype(np.int8)
+        return (
+            imgs.reshape(A, Bl, *imgs.shape[1:]),
+            labs.reshape(A, Bl, *labs.shape[1:]),
+        )
+
+    def _local_batches(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        for flat in self._super_batch_index_chunks():
+            yield self._produce_host(flat)
 
     def _upload(self, item: Tuple[np.ndarray, np.ndarray]):
         imgs, labs = item
@@ -187,59 +204,39 @@ class ShardedLoader(_EpochSampler):
             make_global_array(labs, self.mesh, self.label_spec),
         )
 
+    def _produce(self, flat: np.ndarray):
+        return self._upload(self._produce_host(flat))
+
     def __iter__(self) -> Iterator[Tuple[jax.Array, jax.Array]]:
-        """Yield device-resident super-batches, prefetching uploads so the
-        host→HBM copy of batch k+1 overlaps the compute of batch k (the
-        reference's loop blocks the GPU on every host copy, кластер.py:754)."""
+        """Yield device-resident super-batches in epoch order, with the
+        gather/cast/upload of up to ``prefetch`` future batches running on
+        ``workers`` threads while the consumer computes (the reference's
+        loop blocks the GPU on every host copy, кластер.py:754; numpy's
+        large copies/casts and the device upload release the GIL, so
+        workers > 1 scales with cores on a real pod host).
+
+        Ordering and content are identical for any worker count: batches
+        are yielded strictly in submission order, and each batch is a pure
+        function of its index chunk.  An exception in any worker surfaces
+        at that batch's position; an early consumer ``break`` waits only
+        for the ≤ prefetch+1 already-submitted short tasks.
+        """
         if self.prefetch <= 0:
-            for item in self._local_batches():
-                yield self._upload(item)
+            for flat in self._super_batch_index_chunks():
+                yield self._produce(flat)
             return
-        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
-        stop = object()
-        cancelled = threading.Event()
-
-        def put_or_cancel(payload) -> bool:
-            # Bounded put that aborts if the consumer went away, so an early
-            # `break` can't leave this thread blocked forever holding
-            # device-resident batches.
-            while not cancelled.is_set():
-                try:
-                    q.put(payload, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
-
-        def producer():
-            try:
-                for item in self._local_batches():
-                    if not put_or_cancel(self._upload(item)):
-                        return
-                put_or_cancel(stop)
-            except BaseException as e:  # noqa: BLE001 — re-raised in consumer
-                # Hand the exception to the consumer instead of dying silently
-                # (which would end the epoch early with truncated data).
-                put_or_cancel(e)
-
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is stop:
-                    break
-                if isinstance(item, BaseException):
-                    raise item
-                yield item
-        finally:
-            cancelled.set()
-            while not q.empty():
-                try:
-                    q.get_nowait()
-                except queue.Empty:
-                    break
-            t.join()
+        # In-flight depth must cover the worker count or extra workers sit
+        # idle forever (one submit per consumed batch): workers=N implies
+        # at least N batches in flight, at the corresponding memory cost.
+        depth = max(self.prefetch, self.workers)
+        with ThreadPoolExecutor(max_workers=self.workers) as ex:
+            pending: deque = deque()
+            for flat in self._super_batch_index_chunks():
+                pending.append(ex.submit(self._produce, flat))
+                while len(pending) > depth:
+                    yield pending.popleft().result()
+            while pending:
+                yield pending.popleft().result()
 
 
 class DeviceCachedLoader(_EpochSampler):

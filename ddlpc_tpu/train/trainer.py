@@ -86,6 +86,12 @@ class Trainer:
                 "exclusive: the device cache uploads whole resident arrays, "
                 "exactly what lazy_tiles exists to avoid"
             )
+        if cfg.data.loader_workers > 1 and cfg.data.device_cache:
+            raise ValueError(
+                "data.loader_workers only affects the ShardedLoader host "
+                "path; device_cache gathers batches on device, so worker "
+                "threads have nothing to do — unset one of them"
+            )
         if cfg.data.compact_upload and cfg.data.device_cache:
             raise ValueError(
                 "data.compact_upload only affects the ShardedLoader host-"
@@ -132,7 +138,8 @@ class Trainer:
         )
         loader_kw = (
             {} if cfg.data.device_cache
-            else {"compact": cfg.data.compact_upload}
+            else {"compact": cfg.data.compact_upload,
+                  "workers": cfg.data.loader_workers}
         )
         self.loader = loader_cls(
             self.train_ds,

@@ -51,6 +51,10 @@ def main() -> None:
                    help="bf16 images + int8 labels on the wire "
                         "(ShardedLoader(compact=True), bit-identical for "
                         "bf16-compute models)")
+    p.add_argument("--workers", type=int, default=1,
+                   help="producer threads (ShardedLoader(workers=...)); "
+                        "scales with cores on a pod host, not on this "
+                        "1-core machine")
     p.add_argument("--source", default="memory",
                    choices=["memory", "lazy-npy", "lazy-png"],
                    help="memory: resident SyntheticTiles; lazy-*: a "
@@ -100,7 +104,7 @@ def main() -> None:
     mesh = make_mesh(ParallelConfig())
     loader = ShardedLoader(
         ds, mesh, global_micro_batch=args.micro_batch,
-        sync_period=args.sync, compact=args.compact,
+        sync_period=args.sync, compact=args.compact, workers=args.workers,
     )
     bytes_per_tile = args.size * args.size * (
         (3 * 2 + 1) if args.compact else (3 * 4 + 4)
@@ -112,6 +116,7 @@ def main() -> None:
         "micro_batch": args.micro_batch, "sync_period": args.sync,
         "epochs": args.epochs,
         "compact": args.compact,
+        "workers": args.workers,
         "source": args.source,
         "mb_per_tile": round(bytes_per_tile / 2**20, 3),
     }
@@ -150,7 +155,9 @@ def main() -> None:
 
     key = f"{rec['backend']}_{args.size}px_b{args.micro_batch}x{args.sync}" + (
         "_compact" if args.compact else ""
-    ) + ("" if args.source == "memory" else f"_{args.source}")
+    ) + ("" if args.source == "memory" else f"_{args.source}") + (
+        "" if args.workers == 1 else f"_w{args.workers}"
+    )
     if tmp_ctx is not None:
         tmp_ctx.cleanup()
     merged = {}

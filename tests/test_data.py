@@ -730,3 +730,64 @@ def test_img_npy_pairing_with_dotted_stems(tmp_path):
     np.save(os.path.join(d, "scene.v2.npy"), np.zeros((8, 8), np.int32))
     imgs, masks = _paired_files(d)
     assert set(imgs) == set(masks) == {"scene.v2"}
+
+
+def test_loader_workers_identical_and_ordered(mesh):
+    """workers>1 must change nothing observable: same batches, same order,
+    byte-identical to the single-thread path (the pool only parallelizes
+    production; consumption order is submission order)."""
+    ds = SyntheticTiles(num_tiles=40, image_size=(8, 8), seed=9)
+
+    def epochs(workers, prefetch=3, compact=False):
+        loader = ShardedLoader(
+            ds, mesh, global_micro_batch=8, sync_period=2, seed=4,
+            prefetch=prefetch, workers=workers, compact=compact,
+        )
+        out = []
+        for epoch in range(2):
+            loader.set_epoch(epoch)
+            for imgs, labs in loader:
+                out.append((np.asarray(imgs), np.asarray(labs)))
+        return out
+
+    ref = epochs(workers=1)
+    for arm in (epochs(workers=3), epochs(workers=3, prefetch=0)):
+        assert len(arm) == len(ref)
+        for (ri, rl), (ai, al) in zip(ref, arm):
+            np.testing.assert_array_equal(ri, ai)
+            np.testing.assert_array_equal(rl, al)
+    # The production pod shape: compact casts + label-range checks running
+    # on concurrent workers must match single-threaded compact exactly.
+    ref_c = epochs(workers=1, compact=True)
+    arm_c = epochs(workers=4, compact=True)
+    assert len(arm_c) == len(ref_c)
+    for (ri, rl), (ai, al) in zip(ref_c, arm_c):
+        np.testing.assert_array_equal(ri, ai)
+        np.testing.assert_array_equal(rl, al)
+
+    with pytest.raises(ValueError, match="workers"):
+        ShardedLoader(ds, mesh, global_micro_batch=8, workers=0)
+
+
+def test_loader_workers_exception_and_early_break(mesh):
+    """A worker exception surfaces at its batch's position; an early break
+    doesn't deadlock the pool."""
+    bad = TileDataset(
+        np.zeros((16, 8, 8, 3), np.float32),
+        np.full((16, 8, 8), 200, np.int32),
+    )
+    loader = ShardedLoader(
+        bad, mesh, global_micro_batch=8, sync_period=1, prefetch=2,
+        workers=3, compact=True,
+    )
+    with pytest.raises(ValueError, match=r"\[-1, 127\]"):
+        list(loader)
+
+    ok = SyntheticTiles(num_tiles=40, image_size=(8, 8), seed=9)
+    loader = ShardedLoader(
+        ok, mesh, global_micro_batch=8, sync_period=1, prefetch=2, workers=3
+    )
+    for i, batch in enumerate(loader):
+        if i == 1:
+            break  # must not hang on executor shutdown
+    assert i == 1

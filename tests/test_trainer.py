@@ -332,8 +332,19 @@ def test_compact_upload_config_validation(tmp_path):
     )
     with pytest.raises(ValueError, match="compact_upload"):
         Trainer(cached, resume=False)
-    # Valid flag reaches the loader.
-    ok = dataclasses.replace(
-        cfg, data=dataclasses.replace(cfg.data, compact_upload=True)
+    threaded_cache = dataclasses.replace(
+        cfg,
+        data=dataclasses.replace(
+            cfg.data, loader_workers=4, device_cache=True
+        ),
     )
-    assert Trainer(ok, resume=False).loader.compact is True
+    with pytest.raises(ValueError, match="loader_workers"):
+        Trainer(threaded_cache, resume=False)
+    # Valid flags reach the loader.
+    ok = dataclasses.replace(
+        cfg, data=dataclasses.replace(
+            cfg.data, compact_upload=True, loader_workers=2
+        )
+    )
+    tr = Trainer(ok, resume=False)
+    assert tr.loader.compact is True and tr.loader.workers == 2
