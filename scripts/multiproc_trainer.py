@@ -95,6 +95,10 @@ def child(rank: int, port: int, workdir: str, procs: int, mode: str) -> None:
             num_classes=3,
             lazy_tiles=True,
             compact_upload=True,
+            # NOTE: loader_workers stays 1 here on purpose - the proof's
+            # RecordingDataset asserts on gather CALL ORDER, which a
+            # multi-worker pool does not guarantee (batch YIELD order is
+            # guaranteed and test-pinned in tests/test_data.py).
         )
     elif crops:
         # Scene crops + dihedral augmentation: the host gather path.
