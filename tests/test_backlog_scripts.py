@@ -1,0 +1,77 @@
+"""The TPU pickup queue must not bit-rot while it waits for a chip.
+
+`scripts/run_tpu_backlog_v2.sh` is the round's one-command pickup: every
+Python entry it invokes must exist and parse, and every flag it passes
+must be accepted by that script's argparse — a queue that explodes at
+hour 3 of an unattended drain wastes the only chip time a round gets.
+"""
+
+import ast
+import os
+import shlex
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+QUEUE = os.path.join(REPO, "scripts", "run_tpu_backlog_v2.sh")
+
+
+def _queue_commands():
+    """(target, args) for every `python <target> ...` the queue runs.
+
+    Structural shlex parse, not a regex: the target is the token after
+    `python` (poll probes use `python -c`, recognized and skipped by the
+    literal `-c` target, never by sniffing later flags), and args are
+    every following token up to a shell operator."""
+    cmds = []
+    for line in open(QUEUE):
+        line = line.split("#", 1)[0].strip()
+        if "python" not in line:
+            continue
+        toks = shlex.split(line)
+        while "python" in toks:
+            i = toks.index("python")
+            rest = toks[i + 1:]
+            toks = rest  # keep scanning (e.g. `cmd || python fallback`)
+            if not rest or rest[0] == "-c":
+                continue
+            target = rest[0]
+            if not (target.startswith("scripts/") or target == "bench.py"):
+                continue
+            args = []
+            for t in rest[1:]:
+                if t in (";", "&&", "||", "|", ">", "2>", "&"):
+                    break
+                args.append(t)
+            cmds.append((target, args))
+    return cmds
+
+
+def test_queue_targets_exist_and_parse():
+    cmds = _queue_commands()
+    assert len(cmds) >= 8, f"queue looks truncated: {cmds}"
+    for path, _ in cmds:
+        full = os.path.join(REPO, path)
+        assert os.path.exists(full), f"{path} cited by the queue is missing"
+        ast.parse(open(full).read(), filename=path)
+
+
+def test_queue_flags_accepted():
+    """--help must succeed for each target with no unknown-flag explosions
+    possible: we validate the literal flags against each argparse by
+    running `--help` and checking the flag names appear."""
+    for path, args in _queue_commands():
+        flags = [a for a in args if a.startswith("--")]
+        if not flags:
+            continue
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, path), "--help"],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            cwd=REPO,
+        )
+        assert proc.returncode == 0, f"{path} --help failed:\n{proc.stderr[-500:]}"
+        for flag in flags:
+            assert flag in proc.stdout, (
+                f"{path}: queue passes {flag} but --help does not list it"
+            )
