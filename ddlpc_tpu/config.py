@@ -136,13 +136,13 @@ class DataConfig:
     # has none.  Requires square tiles; incompatible with device_cache
     # (augmentation happens in the host gather path).
     augment: bool = False
-    # Ship bf16 images + int8 labels through the ShardedLoader host-upload
-    # path (44% of the fp32 bytes on the host link).  Numerically identical
-    # for this zoo's bf16-compute models — their first conv casts inputs to
-    # bf16 regardless, and the loss clips/casts labels itself
-    # (tests/test_data.py pins step-level bit-identity).  Requires
-    # num_classes <= 127; rejected together with device_cache (which has
-    # its own compact feed, scripts/convergence_ab.py compact_batch).
+    # Ship bf16 images + int8 labels instead of fp32/int32 — through the
+    # ShardedLoader host-upload path (44% of the wire bytes) or, under
+    # device_cache, as the resident cache itself (44% of the cached HBM).
+    # Numerically identical for this zoo's bf16-compute models — their
+    # first conv casts inputs to bf16 regardless, and the loss clips/casts
+    # labels itself (tests/test_data.py pins step-level bit-identity).
+    # Requires num_classes <= 127.
     compact_upload: bool = False
     # Host-side threads for the ShardedLoader's gather/cast/upload
     # pipeline (SURVEY §7 hard part (c)): numpy's large copies/casts and

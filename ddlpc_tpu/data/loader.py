@@ -31,6 +31,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ddlpc_tpu.data.datasets import TileDataset
 
 
+def _compact_cast(
+    imgs: np.ndarray, labs: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """fp32/int32 → bf16/int8 (44% of the bytes), shared by BOTH transports
+    so the wire form and the resident-cache form can never drift.  Labels
+    must fit int8 with the −1 void sentinel."""
+    if labs.min() < -1 or labs.max() > 127:
+        raise ValueError(
+            f"compact=True needs labels in [-1, 127] for int8, "
+            f"got range [{labs.min()}, {labs.max()}]"
+        )
+    return imgs.astype(ml_dtypes.bfloat16), labs.astype(np.int8)
+
+
 def make_global_array(
     local: np.ndarray, mesh: Mesh, spec: P
 ) -> jax.Array:
@@ -181,13 +195,7 @@ class ShardedLoader(_EpochSampler):
         if self.compact:
             # Cast on the host (worker thread — overlaps consumer compute)
             # so the upload moves 44% of the fp32 bytes.
-            if labs.min() < -1 or labs.max() > 127:
-                raise ValueError(
-                    f"compact=True needs labels in [-1, 127] for int8, "
-                    f"got range [{labs.min()}, {labs.max()}]"
-                )
-            imgs = imgs.astype(ml_dtypes.bfloat16)
-            labs = labs.astype(np.int8)
+            imgs, labs = _compact_cast(imgs, labs)
         return (
             imgs.reshape(A, Bl, *imgs.shape[1:]),
             labs.reshape(A, Bl, *labs.shape[1:]),
@@ -266,6 +274,7 @@ class DeviceCachedLoader(_EpochSampler):
         seed: int = 0,
         data_axis: str = "data",
         space_axis: Optional[str] = None,
+        compact: bool = False,
     ):
         if jax.process_count() != 1:
             raise ValueError(
@@ -293,10 +302,20 @@ class DeviceCachedLoader(_EpochSampler):
         self.seed = seed
         self.tail = "wrap"
         self.super_batch = global_micro_batch * sync_period
+        # compact=True keeps the RESIDENT cache bf16/int8 — 44% of the fp32
+        # HBM for the cached corpus (same numerics argument as the
+        # ShardedLoader's compact wire: the zoo's first conv casts inputs
+        # to bf16 regardless, and the loss clips/casts labels; round-4's
+        # pod emulation measured the device-resident form bit-identical).
+        self.compact = compact
+        img_host, lab_host = (
+            _compact_cast(dataset.images, dataset.labels) if compact
+            else (dataset.images, dataset.labels)
+        )
         self._epoch = 0
         repl = NamedSharding(mesh, P())
-        self._images = jax.device_put(dataset.images, repl)
-        self._labels = jax.device_put(dataset.labels, repl)
+        self._images = jax.device_put(img_host, repl)
+        self._labels = jax.device_put(lab_host, repl)
         batch_sh = NamedSharding(mesh, P(None, data_axis, space_axis))
         A, B = sync_period, global_micro_batch
         h, w, c = dataset.image_shape

@@ -791,3 +791,32 @@ def test_loader_workers_exception_and_early_break(mesh):
         if i == 1:
             break  # must not hang on executor shutdown
     assert i == 1
+
+
+def test_device_cached_compact_matches_sharded_compact(mesh):
+    """DeviceCachedLoader(compact=True) stores the cache bf16/int8; its
+    batches must be byte-identical to ShardedLoader(compact=True)'s (same
+    permutation, same casts — only residency differs), and wide labels
+    must be rejected at construction."""
+    from ddlpc_tpu.data import DeviceCachedLoader
+
+    ds = SyntheticTiles(num_tiles=33, image_size=(8, 8), seed=4)
+    kw = dict(global_micro_batch=8, sync_period=2, shuffle=True, seed=5)
+    import jax.numpy as jnp
+
+    host = ShardedLoader(ds, mesh, prefetch=0, compact=True, **kw)
+    dev = DeviceCachedLoader(ds, mesh, compact=True, **kw)
+    for epoch in (0, 1):
+        host.set_epoch(epoch)
+        dev.set_epoch(epoch)
+        for (hx, hy), (dx, dy) in zip(host, dev):
+            assert dx.dtype == jnp.bfloat16 and dy.dtype == jnp.int8
+            np.testing.assert_array_equal(np.asarray(hx), np.asarray(dx))
+            np.testing.assert_array_equal(np.asarray(hy), np.asarray(dy))
+
+    wide = TileDataset(
+        np.zeros((8, 8, 8, 3), np.float32),
+        np.full((8, 8, 8), 200, np.int32),
+    )
+    with pytest.raises(ValueError, match=r"\[-1, 127\]"):
+        DeviceCachedLoader(wide, mesh, global_micro_batch=8, compact=True)

@@ -324,14 +324,19 @@ def test_compact_upload_config_validation(tmp_path):
     )
     with pytest.raises(ValueError, match="max 127"):
         Trainer(wide, resume=False)
+    # compact + device_cache = compact RESIDENT cache (round 5).
     cached = dataclasses.replace(
         cfg,
         data=dataclasses.replace(
             cfg.data, compact_upload=True, device_cache=True
         ),
     )
-    with pytest.raises(ValueError, match="compact_upload"):
-        Trainer(cached, resume=False)
+    tr_cached = Trainer(cached, resume=False)
+    assert tr_cached.loader.compact is True
+    import jax.numpy as jnp
+
+    assert tr_cached.loader._images.dtype == jnp.bfloat16
+    assert tr_cached.loader._labels.dtype == jnp.int8
     threaded_cache = dataclasses.replace(
         cfg,
         data=dataclasses.replace(
