@@ -302,8 +302,8 @@ def run_scaling() -> list[dict]:
     child = r"""
 import json, time
 import jax
-jax.config.update('jax_platforms', 'cpu')
-jax.config.update('jax_num_cpu_devices', %(n)d)
+from ddlpc_tpu.utils.compat import force_cpu_devices
+force_cpu_devices(%(n)d)
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 from ddlpc_tpu.config import (CompressionConfig, DataConfig, ExperimentConfig,

@@ -283,6 +283,54 @@ class CompressionConfig:
 
 
 @dataclass(frozen=True)
+class ServeConfig:
+    """Inference serving engine (ddlpc_tpu/serve) — one artifact per deploy.
+
+    Batching follows the dynamic micro-batching recipe from the serving
+    literature (PAPERS.md: Gemma-on-TPU serving, pjit scaling): coalesce up
+    to ``max_batch`` queued tiles or ``max_wait_ms`` from the oldest,
+    whichever first.  ``queue_limit`` bounds admission — a submit beyond it
+    is shed with a typed ``Overloaded`` error (fail fast, never queue
+    unboundedly); ``deadline_ms`` expires requests that outlive their
+    usefulness while queued (0 disables).
+    """
+
+    workdir: str = "runs/default"  # training run to restore + reload from
+    host: str = "127.0.0.1"
+    port: int = 8571
+    max_batch: int = 8  # tiles coalesced into one forward
+    max_wait_ms: float = 5.0  # max coalescing latency under light load
+    queue_limit: int = 64  # admission bound (tiles), then Overloaded
+    deadline_ms: float = 2000.0  # per-request queue deadline; 0 = none
+    overlap: float = 0.25  # sliding-window overlap for full scenes
+    metrics_window: int = 2048  # latency ring size for p50/p95/p99
+    metrics_every_s: float = 10.0  # periodic JSONL snapshot cadence; 0 = off
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_json(self, indent: int = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ServeConfig":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - fields
+        if unknown:
+            raise ValueError(
+                f"unknown config key ServeConfig.{sorted(unknown)[0]}"
+            )
+        return cls(**d)
+
+    @classmethod
+    def from_json(cls, s: str) -> "ServeConfig":
+        return cls.from_dict(json.loads(s))
+
+    def replace(self, **kwargs) -> "ServeConfig":
+        return dataclasses.replace(self, **kwargs)
+
+
+@dataclass(frozen=True)
 class ExperimentConfig:
     model: ModelConfig = field(default_factory=ModelConfig)
     data: DataConfig = field(default_factory=DataConfig)

@@ -34,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ddlpc_tpu.config import CompressionConfig, ExperimentConfig
 from ddlpc_tpu.models.layers import group_labels
+from ddlpc_tpu.utils.compat import shard_map
 from ddlpc_tpu.ops.losses import nll_correct_valid, softmax_cross_entropy_sum
 from ddlpc_tpu.ops.metrics import confusion_from_logits
 from ddlpc_tpu.parallel.grad_sync import sync_gradients
@@ -266,12 +267,12 @@ def make_train_step(
         return new_state, metrics
 
     state_spec = P()  # replicated
-    sharded = jax.shard_map(
+    sharded = shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(state_spec, P(None, data_axis), P(None, data_axis)),
         out_specs=(state_spec, state_spec),
-        check_vma=False,
+        check=False,
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate_state else ())
 
@@ -426,12 +427,12 @@ def make_eval_step(
             "pixel_count": lax.psum(count, data_axis),
         }
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P(), P(data_axis), P(data_axis)),
         out_specs=P(),
-        check_vma=False,
+        check=False,
     )
     return jax.jit(sharded)
 

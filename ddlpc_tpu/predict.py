@@ -5,6 +5,11 @@ in-training PNG dump of fixed 512×512 crops (кластер.py:785-790,817-823)
 This restores a trained checkpoint and predicts each input image at its
 NATIVE size via overlap-blended sliding windows, writing a color-mapped
 class-map PNG per input.
+
+This is now a thin client of :mod:`ddlpc_tpu.serve.engine`: the tiler and
+restore logic live there (one tested path shared with the serving engine);
+``sliding_window_logits`` and ``load_run`` stay re-exported here for
+existing callers.
 """
 
 from __future__ import annotations
@@ -12,121 +17,26 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-from typing import Callable, Tuple
 
 import numpy as np
 
-
-def _blend_window(tile: Tuple[int, int]) -> np.ndarray:
-    """[th, tw] separable triangular weights, strictly positive, peaked at
-    the window center — overlapping windows cross-fade instead of seaming."""
-
-    def ramp(n: int) -> np.ndarray:
-        x = np.arange(n, dtype=np.float32)
-        return np.minimum(x + 1.0, n - x) / ((n + 1) / 2)
-
-    return np.outer(ramp(tile[0]), ramp(tile[1])).astype(np.float32)
-
-
-def sliding_window_logits(
-    logits_fn: Callable[..., np.ndarray],
-    state,
-    image: np.ndarray,
-    tile: Tuple[int, int],
-    overlap: float = 0.25,
-    batch: int = 8,
-) -> np.ndarray:
-    """Full-scene logits [H, W, C] for an arbitrary-size image [H, W, c].
-
-    Covers the scene with ``tile``-sized windows at stride
-    ``tile·(1-overlap)`` (the last row/column snaps flush to the edge, so
-    coverage is exact without padding unless the scene is smaller than one
-    tile), runs the compiled ``logits_fn`` on fixed-size window batches, and
-    blends overlaps with triangular weights.
-    """
-    if not 0.0 <= overlap < 1.0:
-        # A negative overlap would stride past the tile, leaving wsum==0
-        # gaps whose 0/0 logits silently argmax to class 0.
-        raise ValueError(f"overlap must be in [0, 1), got {overlap}")
-    th, tw = tile
-    h, w = image.shape[:2]
-    pad_h, pad_w = max(th - h, 0), max(tw - w, 0)
-    if pad_h or pad_w:
-        image = np.pad(image, ((0, pad_h), (0, pad_w), (0, 0)))
-    H, W = image.shape[:2]
-
-    def starts(extent: int, size: int, stride: int) -> list[int]:
-        out = list(range(0, extent - size + 1, stride))
-        if out[-1] != extent - size:
-            out.append(extent - size)
-        return out
-
-    sh = max(int(th * (1.0 - overlap)), 1)
-    sw = max(int(tw * (1.0 - overlap)), 1)
-    origins = [(y, x) for y in starts(H, th, sh) for x in starts(W, tw, sw)]
-
-    weight = _blend_window(tile)
-    acc: np.ndarray | None = None
-    wsum = np.zeros((H, W, 1), np.float32)
-    for i in range(0, len(origins), batch):
-        chunk = origins[i : i + batch]
-        windows = np.stack(
-            [image[y : y + th, x : x + tw] for y, x in chunk]
-        )
-        valid = len(chunk)
-        if valid < batch:  # pad to the compiled batch size
-            windows = np.concatenate(
-                [windows, np.repeat(windows[-1:], batch - valid, axis=0)]
-            )
-        logits = np.asarray(logits_fn(state, windows), np.float32)[:valid]
-        if acc is None:
-            acc = np.zeros((H, W, logits.shape[-1]), np.float32)
-        for (y, x), tile_logits in zip(chunk, logits):
-            acc[y : y + th, x : x + tw] += tile_logits * weight[..., None]
-            wsum[y : y + th, x : x + tw, 0] += weight
-    assert acc is not None
-    out = acc / wsum
-    return out[:h, :w]
+from ddlpc_tpu.serve.engine import (  # noqa: F401  (public re-exports)
+    InferenceEngine,
+    _blend_window,
+    sliding_window_logits,
+)
 
 
 def load_run(workdir: str):
     """(cfg, state, logits_fn, channels) restored from a training run.
 
-    Input channel count comes from the checkpoint metadata (the Trainer
-    records what the dataset actually had) — NOT a hardcoded 3, which made
-    non-RGB checkpoints unrestorable (ADVICE r1).
+    Back-compat shim over ``InferenceEngine.from_workdir`` — new code should
+    use the engine directly (it adds the compiled-shape cache + hot reload).
     """
-    import jax
+    from ddlpc_tpu.parallel.train_step import make_logits_fn
 
-    from ddlpc_tpu.config import ExperimentConfig
-    from ddlpc_tpu.models import build_model
-    from ddlpc_tpu.parallel.train_step import (
-        create_train_state,
-        make_logits_fn,
-    )
-    from ddlpc_tpu.train import checkpoint as ckpt
-    from ddlpc_tpu.train.optim import build_optimizer
-
-    with open(os.path.join(workdir, "config.json")) as f:
-        cfg = ExperimentConfig.from_json(f.read())
-    ckpt_dir = os.path.join(workdir, "checkpoints")
-    step = ckpt.latest_step(ckpt_dir)
-    if step is None:
-        raise FileNotFoundError(f"no checkpoints in {ckpt_dir}")
-    meta = ckpt.peek_metadata(ckpt_dir, step)
-    channels = int(meta.get("input_channels", 3))
-    # Inference is single-device: no mesh axis for BN stats.
-    model = build_model(cfg.model, norm_axis_name=None)
-    # Dummy schedule horizon: only the optimizer state STRUCTURE matters
-    # for restore, and decaying schedules would refuse total_steps=None.
-    tx = build_optimizer(cfg.train, total_steps=1)
-    h, w = cfg.data.image_size
-    state = create_train_state(
-        model, tx, jax.random.key(0), (1, h, w, channels)
-    )
-    state, meta = ckpt.restore_checkpoint(ckpt_dir, state)
-    print(f"restored step {meta.get('step')} (epoch {meta.get('epoch')})")
-    return cfg, state, make_logits_fn(model), channels
+    eng = InferenceEngine.from_workdir(workdir)
+    return eng.cfg, eng.state, make_logits_fn(eng.model), eng.channels
 
 
 def main(argv=None) -> int:
@@ -147,8 +57,8 @@ def main(argv=None) -> int:
 
     from ddlpc_tpu.train.observability import class_palette
 
-    cfg, state, logits_fn, channels = load_run(args.workdir)
-    h, w = cfg.data.image_size
+    engine = InferenceEngine.from_workdir(args.workdir, max_bucket=args.batch)
+    cfg = engine.cfg
 
     out_dir = args.output or os.path.join(args.workdir, "predictions")
     os.makedirs(out_dir, exist_ok=True)
@@ -168,17 +78,11 @@ def main(argv=None) -> int:
         # Native size (image_size=None): the sliding window handles any
         # geometry; preprocessing stays shared with the training readers.
         image = load_image_file(
-            os.path.join(args.input, n), None, channels=channels
+            os.path.join(args.input, n), None, channels=engine.channels
         )
-        logits = sliding_window_logits(
-            logits_fn,
-            state,
-            image,
-            tile=(h, w),
-            overlap=args.overlap,
-            batch=args.batch,
+        pred = engine.predict_classes(
+            image, overlap=args.overlap, batch=args.batch
         )
-        pred = np.argmax(logits, axis=-1)
         stem = n.rsplit(".", 1)[0]
         Image.fromarray(pal[np.clip(pred, 0, cfg.model.num_classes - 1)]).save(
             os.path.join(out_dir, f"{stem}_pred.png")

@@ -1,0 +1,32 @@
+"""`ddlpc_tpu.serve` — batched, backpressured inference serving.
+
+The training side of this framework replaces the reference's hand-rolled
+socket cluster; this package is the inference counterpart of that ambition
+(ROADMAP north star: "serves heavy traffic").  Layers, bottom-up:
+
+- :mod:`engine`   — checkpoint restore, shape-bucketed jitted forward cache,
+                    the overlap-blended sliding-window tiler (hoisted out of
+                    ``predict.py``), and lock-guarded checkpoint hot-reload.
+- :mod:`batching` — bounded admission queue + dynamic micro-batcher:
+                    coalesce up to ``max_batch`` requests or ``max_wait_ms``,
+                    whichever first; per-request deadlines; typed
+                    ``Overloaded`` load-shedding; graceful drain.
+- :mod:`metrics`  — latency quantiles (p50/p95/p99), queue depth, batch
+                    occupancy, tiles/sec — emitted on the same JSONL stream
+                    shape as ``train/observability.py``.
+- :mod:`server`   — stdlib ``http.server`` front end (``/healthz``,
+                    ``/predict``, ``/metrics``, ``/reload``) over a
+                    ``ServingFrontend`` that ties the three together.
+"""
+
+from ddlpc_tpu.serve.batching import (  # noqa: F401
+    DeadlineExceeded,
+    EngineClosed,
+    MicroBatcher,
+    Overloaded,
+)
+from ddlpc_tpu.serve.engine import (  # noqa: F401
+    InferenceEngine,
+    sliding_window_logits,
+)
+from ddlpc_tpu.serve.metrics import ServeMetrics  # noqa: F401

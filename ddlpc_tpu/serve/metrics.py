@@ -1,0 +1,127 @@
+"""Serving observability: latency quantiles, queue depth, occupancy, rates.
+
+Rides the same JSONL stream shape as training (`train/observability.py`
+``MetricsLogger``): one flat JSON object per emit, so the tooling that tails
+training metrics tails serving metrics unchanged.  Quantiles come from a
+bounded ring of recent request latencies (windowed, not lifetime, so a load
+spike is visible in p99 and then ages out); rates (requests/sec, tiles/sec)
+are measured over the interval since the previous snapshot.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class ServeMetrics:
+    """Thread-safe counters + windowed latency histogram for the serve path.
+
+    Hooked by the frontend (``record_request``: one call per scene request
+    with its end-to-end latency and tile count — so ``requests_per_sec`` is
+    scene throughput and ``tiles_per_sec`` is accelerator throughput, which
+    differ for multi-window scenes) and by the batcher (batch occupancy,
+    queue depth, sheds, deadline misses).
+    """
+
+    def __init__(self, window: int = 2048):
+        self._lock = threading.Lock()
+        self._lat = deque(maxlen=window)  # seconds, most-recent window
+        self.requests = 0
+        self.tiles = 0
+        self.shed = 0
+        self.deadline_exceeded = 0
+        self.batches = 0
+        self._occupancy_sum = 0.0
+        self.queue_depth = 0
+        self._t0 = time.monotonic()
+        self._last_t = self._t0
+        self._last_requests = 0
+        self._last_tiles = 0
+
+    # ---- recording hooks ---------------------------------------------------
+
+    def record_request(self, latency_s: float, tiles: int = 1) -> None:
+        with self._lock:
+            self._lat.append(float(latency_s))
+            self.requests += 1
+            self.tiles += int(tiles)
+
+    def record_batch(self, size: int, capacity: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self._occupancy_sum += size / max(capacity, 1)
+
+    def record_shed(self, n: int = 1) -> None:
+        with self._lock:
+            self.shed += int(n)
+
+    def record_deadline(self, n: int = 1) -> None:
+        with self._lock:
+            self.deadline_exceeded += int(n)
+
+    def set_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth = int(depth)
+
+    # ---- readout -----------------------------------------------------------
+
+    def percentiles_ms(self) -> Dict[str, Optional[float]]:
+        with self._lock:
+            lat = list(self._lat)
+        if not lat:
+            return {"p50_ms": None, "p95_ms": None, "p99_ms": None}
+        p50, p95, p99 = np.percentile(np.asarray(lat) * 1000.0, [50, 95, 99])
+        return {
+            "p50_ms": round(float(p50), 3),
+            "p95_ms": round(float(p95), 3),
+            "p99_ms": round(float(p99), 3),
+        }
+
+    def snapshot(self, advance: bool = True) -> Dict[str, object]:
+        """One flat record: cumulative counters + windowed quantiles +
+        interval rates.
+
+        ``advance=True`` (the periodic emitter, the bench) closes the rate
+        interval; ``advance=False`` (ad-hoc readers like ``GET /metrics``)
+        reads rates over the currently open interval WITHOUT resetting it,
+        so scrapes cannot corrupt the emitter's cadence."""
+        pct = self.percentiles_ms()
+        with self._lock:
+            now = time.monotonic()
+            dt = max(now - self._last_t, 1e-9)
+            req_rate = (self.requests - self._last_requests) / dt
+            tile_rate = (self.tiles - self._last_tiles) / dt
+            if advance:
+                self._last_t = now
+                self._last_requests = self.requests
+                self._last_tiles = self.tiles
+            occupancy = (
+                self._occupancy_sum / self.batches if self.batches else None
+            )
+            return {
+                "kind": "serve",
+                **pct,
+                "requests": self.requests,
+                "tiles": self.tiles,
+                "shed": self.shed,
+                "deadline_exceeded": self.deadline_exceeded,
+                "batches": self.batches,
+                "batch_occupancy": (
+                    round(occupancy, 4) if occupancy is not None else None
+                ),
+                "queue_depth": self.queue_depth,
+                "requests_per_sec": round(req_rate, 3),
+                "tiles_per_sec": round(tile_rate, 3),
+                "uptime_s": round(now - self._t0, 3),
+            }
+
+    def emit(self, logger) -> Dict[str, object]:
+        """Write a snapshot onto a ``MetricsLogger`` JSONL stream."""
+        snap = self.snapshot()
+        logger.log(snap, echo=False)
+        return snap

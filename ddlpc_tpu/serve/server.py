@@ -1,0 +1,355 @@
+"""Serving front end: stdlib HTTP over the engine + batcher + metrics.
+
+Two layers so the protocol stays swappable:
+
+- :class:`ServingFrontend` — protocol-agnostic: full-scene predict (plan →
+  batched windows → stitch), health/metrics readouts, hot-reload, graceful
+  drain.  Tests and the load generator drive this directly.
+- ``http.server`` handler — ``GET /healthz``, ``GET /metrics``,
+  ``POST /predict`` (npy image body → npy class-map body),
+  ``POST /reload``.  A deliberately boring stdlib front end: the workload
+  is compute-bound on the accelerator, so a threading HTTP server whose
+  request threads block on batcher futures is enough — the batcher is the
+  throughput engine, not the socket layer.
+
+Overload semantics on the wire: ``Overloaded`` → 503 + Retry-After,
+``DeadlineExceeded`` → 504, draining → 503.  Clients get a fast typed
+rejection, never an unbounded queue wait (ISSUE 1 tentpole contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import io
+import json
+import signal
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from ddlpc_tpu.config import ServeConfig
+from ddlpc_tpu.serve.batching import (
+    DeadlineExceeded,
+    EngineClosed,
+    MicroBatcher,
+    Overloaded,
+)
+from ddlpc_tpu.serve.engine import (
+    InferenceEngine,
+    Stitcher,
+    window_plan,
+)
+from ddlpc_tpu.serve.metrics import ServeMetrics
+
+
+class ServingFrontend:
+    """Engine + batcher + metrics behind one protocol-agnostic API."""
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        cfg: Optional[ServeConfig] = None,
+        logger=None,
+    ):
+        self.engine = engine
+        self.cfg = cfg or ServeConfig()
+        self.metrics = ServeMetrics(window=self.cfg.metrics_window)
+        self.batcher = MicroBatcher(
+            engine.forward_windows,
+            max_batch=self.cfg.max_batch,
+            max_wait_ms=self.cfg.max_wait_ms,
+            queue_limit=self.cfg.queue_limit,
+            metrics=self.metrics,
+        )
+        self.logger = logger
+        self.draining = False
+        self._emit_stop = threading.Event()
+        self._emitter: Optional[threading.Thread] = None
+        if logger is not None and self.cfg.metrics_every_s > 0:
+            self._emitter = threading.Thread(
+                target=self._emit_loop, name="serve-metrics", daemon=True
+            )
+            self._emitter.start()
+
+    def _emit_loop(self) -> None:
+        while not self._emit_stop.wait(self.cfg.metrics_every_s):
+            self.metrics.emit(self.logger)
+
+    # ---- request paths -----------------------------------------------------
+
+    def predict_logits(
+        self, image: np.ndarray, overlap: Optional[float] = None
+    ) -> np.ndarray:
+        """Full-scene logits with every window routed through the batcher —
+        windows from concurrent scenes coalesce into shared forwards."""
+        image = np.asarray(image, np.float32)
+        if image.ndim != 3:
+            raise ValueError(f"expected [H, W, C] image, got {image.shape}")
+        if image.shape[-1] != self.engine.channels:
+            raise ValueError(
+                f"expected {self.engine.channels} channels, got "
+                f"{image.shape[-1]}"
+            )
+        overlap = self.cfg.overlap if overlap is None else overlap
+        th, tw = self.engine.tile
+        t0 = time.monotonic()
+        padded, origins, (h, w) = window_plan(image, self.engine.tile, overlap)
+        # Chunked admission: each chunk is admitted all-or-nothing (a shed
+        # chunk never half-occupies the queue), but a scene that tiles into
+        # more windows than the queue holds is NOT permanently rejected —
+        # it streams through in chunks of at most half the queue, which
+        # also stops one huge scene from monopolizing admission.  Blending
+        # happens as futures resolve, so peak memory is the accumulator +
+        # one in-flight chunk.  result() gets a margin on top of the queue
+        # deadline so a wedged worker surfaces as an error, not a hang.
+        st = Stitcher(self.engine.tile, padded.shape[:2], (h, w))
+        chunk_size = max(1, self.cfg.queue_limit // 2)
+        timeout = (
+            self.cfg.deadline_ms / 1000.0 + 60.0
+            if self.cfg.deadline_ms
+            else None
+        )
+        for i in range(0, len(origins), chunk_size):
+            chunk = origins[i : i + chunk_size]
+            windows = [padded[y : y + th, x : x + tw] for y, x in chunk]
+            futures = self.batcher.submit_many(
+                windows, deadline_ms=self.cfg.deadline_ms or None
+            )
+            try:
+                for origin, fut in zip(chunk, futures):
+                    st.add(origin, fut.result(timeout=timeout))
+            except BaseException:
+                # The scene already failed: cancel still-queued sibling
+                # windows so the batcher stops burning capacity on a
+                # request that got its error response.
+                for fut in futures:
+                    fut.cancel()
+                raise
+        out = st.finish()
+        self.metrics.record_request(
+            time.monotonic() - t0, tiles=len(origins)
+        )
+        return out
+
+    def predict_classes(
+        self, image: np.ndarray, overlap: Optional[float] = None
+    ) -> np.ndarray:
+        return np.argmax(self.predict_logits(image, overlap), axis=-1).astype(
+            np.int32
+        )
+
+    def reload(self, workdir: Optional[str] = None) -> dict:
+        meta = self.engine.reload(workdir)
+        if self.logger is not None:
+            self.logger.log(
+                {
+                    "kind": "serve_reload",
+                    "version": self.engine.version,
+                    "step": meta.get("step"),
+                },
+                echo=False,
+            )
+        return meta
+
+    def healthz(self) -> dict:
+        return {
+            "status": "draining" if self.draining else "ok",
+            "version": self.engine.version,
+            "checkpoint_step": self.engine.checkpoint_step,
+            "tile": list(self.engine.tile),
+            "channels": self.engine.channels,
+            "queue_depth": self.batcher.queue_depth,
+            "compiled_shapes": self.engine.compiled_shapes,
+        }
+
+    def close(self, drain: bool = True) -> None:
+        """Stop admission, finish queued work (drain=True), stop emitting."""
+        self.draining = True
+        self.batcher.close(drain=drain)
+        self._emit_stop.set()
+        if self._emitter is not None:
+            self._emitter.join(timeout=5.0)
+        if self.logger is not None:
+            self.metrics.emit(self.logger)
+
+
+# ---- HTTP layer -------------------------------------------------------------
+
+
+def _load_npy(body: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(body), allow_pickle=False)
+
+
+def _dump_npy(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, arr, allow_pickle=False)
+    return buf.getvalue()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "ddlpc-serve/1"
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def frontend(self) -> ServingFrontend:
+        return self.server.frontend  # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args):  # quiet by default; metrics cover it
+        pass
+
+    def _send_json(self, code: int, obj: dict, extra=()) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in extra:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_npy(self, arr: np.ndarray) -> None:
+        body = _dump_npy(arr)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-npy")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:
+        path = urlparse(self.path).path
+        if path == "/healthz":
+            h = self.frontend.healthz()
+            self._send_json(200 if h["status"] == "ok" else 503, h)
+        elif path == "/metrics":
+            # advance=False: a scrape must not reset the rate interval the
+            # periodic JSONL emitter (and the bench) measure over.
+            self._send_json(
+                200, self.frontend.metrics.snapshot(advance=False)
+            )
+        else:
+            self._send_json(404, {"error": f"no route {path}"})
+
+    def do_POST(self) -> None:
+        parsed = urlparse(self.path)
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length) if length else b""
+            if parsed.path == "/predict":
+                self._predict(parsed, body)
+            elif parsed.path == "/reload":
+                self._reload(body)
+            else:
+                self._send_json(404, {"error": f"no route {parsed.path}"})
+        except BrokenPipeError:
+            pass
+
+    def _predict(self, parsed, body: bytes) -> None:
+        try:
+            image = _load_npy(body)
+        except Exception as e:
+            self._send_json(400, {"error": f"body is not a valid .npy: {e}"})
+            return
+        q = parse_qs(parsed.query)
+        try:
+            overlap = float(q["overlap"][0]) if "overlap" in q else None
+            pred = self.frontend.predict_classes(image, overlap=overlap)
+        except Overloaded as e:
+            self._send_json(503, {"error": str(e)}, extra=[("Retry-After", "1")])
+        except (DeadlineExceeded, TimeoutError,
+                concurrent.futures.TimeoutError) as e:
+            # futures.TimeoutError is NOT the builtin before 3.11; both mean
+            # the same here — the worker didn't produce a result in time.
+            self._send_json(504, {"error": str(e) or "timed out"})
+        except EngineClosed as e:
+            self._send_json(503, {"error": str(e)})
+        except ValueError as e:
+            self._send_json(400, {"error": str(e)})
+        except Exception as e:  # engine/XLA failure: a 500, not a dropped
+            # connection (socketserver would close the socket replyless and
+            # lose any pipelined keep-alive request with it)
+            self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+        else:
+            self._send_npy(pred)
+
+    def _reload(self, body: bytes) -> None:
+        try:
+            req = json.loads(body) if body else {}
+            meta = self.frontend.reload(req.get("workdir"))
+        except FileNotFoundError as e:
+            self._send_json(404, {"error": str(e)})
+        except Exception as e:
+            self._send_json(500, {"error": str(e)})
+        else:
+            self._send_json(
+                200,
+                {"version": self.frontend.engine.version,
+                 "step": meta.get("step")},
+            )
+
+
+def make_server(
+    frontend: ServingFrontend, host: str = "127.0.0.1", port: int = 0
+) -> ThreadingHTTPServer:
+    """Bind a threading HTTP server over ``frontend`` (port 0 = ephemeral)."""
+    server = ThreadingHTTPServer((host, port), _Handler)
+    server.daemon_threads = True
+    server.frontend = frontend  # type: ignore[attr-defined]
+    return server
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m ddlpc_tpu.serve.server")
+    p.add_argument("--config", help="ServeConfig JSON (configs/serve_*.json)")
+    p.add_argument("--workdir", help="training run to serve (overrides config)")
+    p.add_argument("--host")
+    p.add_argument("--port", type=int)
+    args = p.parse_args(argv)
+
+    cfg = ServeConfig()
+    if args.config:
+        with open(args.config) as f:
+            cfg = ServeConfig.from_json(f.read())
+    overrides = {
+        k: v
+        for k, v in
+        (("workdir", args.workdir), ("host", args.host), ("port", args.port))
+        if v is not None
+    }
+    if overrides:
+        cfg = cfg.replace(**overrides)
+
+    from ddlpc_tpu.train.observability import MetricsLogger
+
+    engine = InferenceEngine.from_workdir(cfg.workdir, max_bucket=cfg.max_batch)
+    engine.warmup()  # compile every bucket before declaring ready
+    logger = MetricsLogger(cfg.workdir, basename="serve_metrics")
+    frontend = ServingFrontend(engine, cfg, logger=logger)
+    server = make_server(frontend, cfg.host, cfg.port)
+
+    def _shutdown(signum, frame):
+        # Graceful drain: stop accepting, finish queued work, then exit.
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    signal.signal(signal.SIGINT, _shutdown)
+    print(
+        f"serving {cfg.workdir} on http://{cfg.host}:{server.server_address[1]}"
+        f" (tile {engine.tile}, max_batch {cfg.max_batch})",
+        flush=True,
+    )
+    try:
+        server.serve_forever()
+    finally:
+        frontend.close(drain=True)
+        server.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

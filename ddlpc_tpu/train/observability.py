@@ -51,14 +51,22 @@ class MetricsLogger:
     the machine-readable record new in this framework.
     """
 
-    def __init__(self, workdir: str, run_config_json: Optional[str] = None):
+    def __init__(
+        self,
+        workdir: str,
+        run_config_json: Optional[str] = None,
+        basename: str = "metrics",
+    ):
+        # ``basename`` lets other subsystems share this stream format
+        # without clobbering the training log (serve/metrics.py writes
+        # ``serve_metrics.jsonl`` next to ``metrics.jsonl``).
         self.enabled = jax.process_index() == 0
         self.workdir = workdir
         if not self.enabled:
             return
         os.makedirs(workdir, exist_ok=True)
-        self.txt_path = os.path.join(workdir, "metrics.txt")
-        self.jsonl_path = os.path.join(workdir, "metrics.jsonl")
+        self.txt_path = os.path.join(workdir, f"{basename}.txt")
+        self.jsonl_path = os.path.join(workdir, f"{basename}.jsonl")
         if run_config_json is not None:
             # Run-config header, as the reference writes before epoch 0
             # (кластер.py:715-716).

@@ -26,12 +26,12 @@ import sys
 
 
 def child(rank: int, port: int) -> None:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from ddlpc_tpu.utils.compat import force_cpu_devices
+
+    force_cpu_devices(4)  # 4 local → 8 global devices
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 4)  # 4 local → 8 global devices
-
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from ddlpc_tpu.parallel.mesh import initialize_distributed
 
     initialize_distributed(

@@ -47,18 +47,18 @@ import time
 
 
 def child(rank: int, port: int, workdir: str, procs: int, mode: str) -> None:
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     # N=2 procs × 2 local devices (the r3 layout) and N=4 procs × 1 local
     # device run the SAME 4-device SPMD program over more process
     # boundaries; N=8 procs × 1 local device widens the mesh to 8 (micro
     # batch 1/replica).  main() restricts --procs to {2, 4, 8} so the
     # global micro-batch of 8 always divides evenly.
     local_devices = max(1, 4 // procs)
-    jax.config.update("jax_num_cpu_devices", local_devices)
+    from ddlpc_tpu.utils.compat import force_cpu_devices
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    force_cpu_devices(local_devices)
+    import jax  # noqa: F401 — used by the training body below
+
     from ddlpc_tpu.parallel.mesh import initialize_distributed
 
     initialize_distributed(

@@ -31,14 +31,16 @@ import time
 
 
 def child(rank: int, port: int, elements: int, out: str, procs: int) -> None:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from ddlpc_tpu.utils.compat import force_cpu_devices
+
+    # 1 device/process: every collective hop crosses the process boundary —
+    # no intra-process shortcut.
+    force_cpu_devices(1)
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 1)  # 1 device/process: every
-    # collective hop crosses the process boundary — no intra-process shortcut.
-
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from ddlpc_tpu.parallel.mesh import initialize_distributed
+    from ddlpc_tpu.utils.compat import shard_map  # noqa: F401 (used below)
 
     initialize_distributed(
         coordinator_address=f"127.0.0.1:{port}", num_processes=procs, process_id=rank
@@ -80,12 +82,12 @@ def child(rank: int, port: int, elements: int, out: str, procs: int) -> None:
         results = {}
         for length in (length_a, length_b):
             f = jax.jit(
-                jax.shard_map(
+                shard_map(
                     functools.partial(loop, length=length),
                     mesh=mesh,
                     in_specs=P("data"),
                     out_specs=P(),
-                    check_vma=False,
+                    check=False,
                 )
             )
             g = jnp.concatenate([local] * n_dev)  # global [n·e] sharded over n

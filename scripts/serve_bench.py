@@ -1,0 +1,208 @@
+"""Serving benchmark: closed-loop load against the batched inference engine.
+
+Driver contract (same shape as bench.py): prints exactly ONE JSON line
+  {"metric": "serve_p99_ms", "value": N, "unit": "ms", "vs_baseline": ...}
+with the serving-specific extras (p50, tiles/sec, batch occupancy, shed
+count) carried alongside.  ``vs_baseline`` is BASELINE_P99_MS / p99 so >1 is
+better, matching the higher-is-better convention of the training metric.
+
+Closed loop: ``--clients`` threads each submit a scene, wait for the class
+map, and immediately submit the next — the standard saturating load shape
+for batching servers (open-loop arrival would need a rate model).  All
+latency/throughput numbers come from the SERVING METRICS STREAM
+(serve/metrics.py), not bench-side stopwatches, so the benchmark also
+exercises the observability path end-to-end.
+
+Default run needs no checkpoint on disk: it materializes a tiny synthetic
+run in a temp dir (CPU-friendly, CI time budget); point --workdir at a real
+run to benchmark a real model.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Serving p99 target for the CI-shaped synthetic load (tiny model, CPU):
+# generous on purpose — the gate is "batching works and latency is bounded",
+# not a hardware claim.
+BASELINE_P99_MS = 2000.0
+
+
+def make_tiny_run(
+    workdir: str,
+    tile: int = 32,
+    num_classes: int = 4,
+    seed: int = 0,
+    step: int = 1,
+):
+    """Materialize a restorable synthetic run (config.json + checkpoint).
+
+    ``seed`` keys the params (different seeds → different predictions —
+    the serve tests use that for hot-reload proofs); ``step`` numbers the
+    checkpoint so successive calls create a newer restore target.  Shared
+    with tests/test_serve.py so the bench and the unit tests can never
+    diverge on what "a restorable run" means.  Returns the config.
+    """
+    import jax
+
+    from ddlpc_tpu.config import DataConfig, ExperimentConfig, ModelConfig
+    from ddlpc_tpu.models import build_model
+    from ddlpc_tpu.parallel.train_step import create_train_state
+    from ddlpc_tpu.train import checkpoint as ckpt
+    from ddlpc_tpu.train.optim import build_optimizer
+
+    cfg = ExperimentConfig(
+        model=ModelConfig(
+            features=(8, 16), bottleneck_features=16, num_classes=num_classes
+        ),
+        data=DataConfig(
+            dataset="synthetic", image_size=(tile, tile),
+            num_classes=num_classes,
+        ),
+        workdir=workdir,
+    )
+    os.makedirs(workdir, exist_ok=True)
+    with open(os.path.join(workdir, "config.json"), "w") as f:
+        f.write(cfg.to_json())
+    model = build_model(cfg.model, norm_axis_name=None)
+    tx = build_optimizer(cfg.train, total_steps=1)
+    state = create_train_state(
+        model, tx, jax.random.key(seed), (1, tile, tile, 3)
+    )
+    ckpt.save_checkpoint(
+        os.path.join(workdir, "checkpoints"), state, step,
+        metadata={"input_channels": 3, "epoch": 0},
+    )
+    return cfg
+
+
+def run_load(
+    workdir: str,
+    clients: int,
+    requests: int,
+    scene: int,
+    max_batch: int,
+    max_wait_ms: float,
+) -> dict:
+    import numpy as np
+
+    from ddlpc_tpu.config import ServeConfig
+    from ddlpc_tpu.serve.engine import InferenceEngine
+    from ddlpc_tpu.serve.server import ServingFrontend
+
+    engine = InferenceEngine.from_workdir(
+        workdir, max_bucket=max_batch, echo=False
+    )
+    cfg = ServeConfig(
+        workdir=workdir,
+        max_batch=max_batch,
+        max_wait_ms=max_wait_ms,
+        queue_limit=max(4 * max_batch * clients, 64),
+        deadline_ms=0.0,  # closed loop saturates; deadlines would just shed
+    )
+    frontend = ServingFrontend(engine, cfg)
+
+    rng = np.random.default_rng(0)
+    th, tw = engine.tile
+    h = w = max(scene, th)
+    images = [
+        rng.uniform(0, 1, (h, w, engine.channels)).astype(np.float32)
+        for _ in range(clients)
+    ]
+    # Warmup: compile every bucket the steady loop can hit before timing —
+    # otherwise p99 measures XLA compile spikes, not serving latency.
+    engine.warmup()
+    frontend.predict_classes(images[0])
+    frontend.metrics.snapshot()  # reset the rate interval post-compile
+
+    per_client = max(requests // clients, 1)
+    errors = []
+
+    def client(i: int) -> None:
+        for _ in range(per_client):
+            try:
+                frontend.predict_classes(images[i])
+            except Exception as e:  # noqa: BLE001 — reported, not raised
+                errors.append(repr(e))
+
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t0
+    snap = frontend.metrics.snapshot()
+    frontend.close(drain=True)
+
+    p99 = snap["p99_ms"]
+    return {
+        "metric": "serve_p99_ms",
+        "value": p99,
+        "unit": "ms",
+        "vs_baseline": (
+            round(BASELINE_P99_MS / p99, 3) if p99 else None
+        ),
+        "p50_ms": snap["p50_ms"],
+        "p95_ms": snap["p95_ms"],
+        "tiles_per_sec": snap["tiles_per_sec"],
+        "requests_per_sec": snap["requests_per_sec"],
+        "batch_occupancy": snap["batch_occupancy"],
+        "tiles": snap["tiles"],
+        "shed": snap["shed"],
+        "errors": len(errors),
+        "clients": clients,
+        "scene_requests": per_client * clients,
+        "wall_s": round(wall_s, 3),
+        "max_batch": max_batch,
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument(
+        "--workdir",
+        help="training run to serve (default: tiny synthetic run in a "
+        "temp dir)",
+    )
+    p.add_argument("--clients", type=int, default=4)
+    p.add_argument(
+        "--requests", type=int, default=32, help="total scene requests"
+    )
+    p.add_argument(
+        "--scene", type=int, default=48,
+        help="square scene edge (>= tile → multi-window scenes)",
+    )
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-wait-ms", type=float, default=2.0)
+    args = p.parse_args()
+
+    if args.workdir:
+        result = run_load(
+            args.workdir, args.clients, args.requests, args.scene,
+            args.max_batch, args.max_wait_ms,
+        )
+    else:
+        with tempfile.TemporaryDirectory() as tmp:
+            workdir = os.path.join(tmp, "serve_bench_run")
+            make_tiny_run(workdir)
+            result = run_load(
+                workdir, args.clients, args.requests, args.scene,
+                args.max_batch, args.max_wait_ms,
+            )
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

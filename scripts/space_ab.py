@@ -33,9 +33,8 @@ _REPO = os.path.dirname(_SCRIPTS_DIR)
 
 CHILD = r"""
 import json
-import jax
-jax.config.update('jax_platforms', 'cpu')
-jax.config.update('jax_num_cpu_devices', 8)
+from ddlpc_tpu.utils.compat import force_cpu_devices
+force_cpu_devices(8)
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 from ddlpc_tpu.config import (CompressionConfig, DataConfig, ExperimentConfig,

@@ -4,13 +4,19 @@ This is the standard way to test pjit/shard_map collectives without TPU
 hardware (SURVEY §4).  Must run before the first backend initialization; the
 axon sitecustomize force-sets jax_platforms, so we override the config
 directly rather than the env var.
+
+The device-count knob moved across jax releases: newer jax exposes a
+``jax_num_cpu_devices`` config option, older ones (e.g. 0.4.37, the pinned
+toolchain) only honor the ``--xla_force_host_platform_device_count`` XLA
+flag.  ``ddlpc_tpu.utils.compat.force_cpu_devices`` owns that dance (set
+the flag, guard the config option) — safe to call after ``import jax`` as
+long as no device has been touched yet, which is exactly now.
 """
 
 import os
 
 os.environ.setdefault("JAX_NUM_CPU_DEVICES", "8")
 
-import jax
+from ddlpc_tpu.utils.compat import force_cpu_devices
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", int(os.environ["JAX_NUM_CPU_DEVICES"]))
+force_cpu_devices(int(os.environ["JAX_NUM_CPU_DEVICES"]))

@@ -20,6 +20,7 @@ from ddlpc_tpu.config import (
 )
 from ddlpc_tpu.parallel.halo import halo_exchange, sharded_same_conv
 from ddlpc_tpu.parallel.mesh import make_mesh
+from ddlpc_tpu.utils.compat import shard_map
 
 
 @pytest.fixture(scope="module")
@@ -35,7 +36,7 @@ def test_halo_exchange_matches_neighbor_rows(space_mesh):
         return halo_exchange(x_local, "space", halo)
 
     out = jax.jit(
-        jax.shard_map(
+        shard_map(
             body,
             mesh=space_mesh,
             in_specs=P(None, "space"),
@@ -68,7 +69,7 @@ def test_halo_too_large_raises(space_mesh):
 
     def run():
         return jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda v: halo_exchange(v, "space", 3),
                 mesh=space_mesh,
                 in_specs=P(None, "space"),
@@ -90,7 +91,7 @@ def test_sharded_conv_matches_global_conv(space_mesh):
         x, k, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
     )
     sharded = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda v: sharded_same_conv(v, k, "space"),
             mesh=space_mesh,
             in_specs=P(None, "space"),

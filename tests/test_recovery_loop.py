@@ -23,9 +23,9 @@ REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 CHILD = """
 import os, sys, time
 import jax
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
 sys.path.insert(0, {repo_root!r})
+from ddlpc_tpu.utils.compat import force_cpu_devices
+force_cpu_devices(2)
 
 from ddlpc_tpu.config import (
     DataConfig, ExperimentConfig, ModelConfig, TrainConfig,

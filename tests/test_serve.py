@@ -1,0 +1,507 @@
+"""`ddlpc_tpu.serve`: engine restore/jit-cache/hot-reload, micro-batcher
+coalescing/backpressure/deadlines/drain, HTTP front end, metrics (ISSUE 1)."""
+
+import io
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ddlpc_tpu.config import ServeConfig
+from ddlpc_tpu.parallel.train_step import make_logits_fn
+from ddlpc_tpu.serve import (
+    DeadlineExceeded,
+    EngineClosed,
+    InferenceEngine,
+    MicroBatcher,
+    Overloaded,
+    ServeMetrics,
+    sliding_window_logits,
+)
+from ddlpc_tpu.serve.server import ServingFrontend, make_server
+
+TILE = (32, 32)
+NCLASS = 4
+
+
+def write_run(workdir: str, seed: int = 0, step: int = 1):
+    """Materialize a restorable run — the bench's builder, shared so the
+    smoke test and the unit tests agree on what a run looks like.
+    Different seeds → different params → different predictions (the
+    hot-reload tests rely on that)."""
+    from scripts.serve_bench import make_tiny_run
+
+    return make_tiny_run(
+        workdir, tile=TILE[0], num_classes=NCLASS, seed=seed, step=step
+    )
+
+
+@pytest.fixture(scope="module")
+def run_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("serve_run"))
+    write_run(d)
+    return d
+
+
+@pytest.fixture(scope="module")
+def engine(run_dir):
+    return InferenceEngine.from_workdir(run_dir, echo=False)
+
+
+# ---- micro-batcher (no jax; fake forwards) ----------------------------------
+
+
+def test_batcher_coalesces_fewer_forwards_than_requests():
+    """ISSUE 1 acceptance: N concurrent requests, strictly fewer than N
+    underlying forward calls.  Deferred start makes it deterministic: all 8
+    are queued before the worker wakes, so they coalesce into ceil(8/4)=2
+    batches."""
+    N, calls = 8, []
+
+    def forward(items):
+        calls.append(len(items))
+        return [x * 10 for x in items]
+
+    b = MicroBatcher(forward, max_batch=4, max_wait_ms=50, queue_limit=64,
+                     start=False)
+    futs = [b.submit(i) for i in range(N)]
+    b.start()
+    assert [f.result(timeout=5) for f in futs] == [i * 10 for i in range(N)]
+    b.close()
+    assert b.forward_count < N
+    assert b.forward_count == 2
+    assert calls == [4, 4]
+
+
+def test_batcher_coalesces_under_real_concurrency():
+    """Threaded submitters (the HTTP-server shape) still coalesce."""
+    N = 6
+    done = threading.Barrier(N + 1)
+
+    def forward(items):
+        time.sleep(0.01)
+        return [x + 1 for x in items]
+
+    b = MicroBatcher(forward, max_batch=8, max_wait_ms=100, queue_limit=64)
+    results = [None] * N
+
+    def client(i):
+        done.wait()
+        results[i] = b.submit(i).result(timeout=10)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(N)]
+    for t in threads:
+        t.start()
+    done.wait()
+    for t in threads:
+        t.join()
+    b.close()
+    assert results == [i + 1 for i in range(N)]
+    assert b.forward_count < N
+
+
+def test_bounded_queue_sheds_with_typed_overloaded():
+    """ISSUE 1 acceptance: a full queue rejects with Overloaded immediately
+    — never an unbounded wait."""
+    metrics = ServeMetrics()
+    release = threading.Event()
+
+    def slow_forward(items):
+        release.wait(10)
+        return items
+
+    b = MicroBatcher(slow_forward, max_batch=1, max_wait_ms=0, queue_limit=4,
+                     metrics=metrics)
+    # One request in flight (worker blocked in forward) ...
+    futs = [b.submit(0)]
+    for _ in range(400):
+        if b.queue_depth == 0:
+            break
+        time.sleep(0.005)
+    assert b.queue_depth == 0
+    # ... then fill the queue to its bound; the next submit must shed FAST
+    # with the typed error, not block until capacity frees up.
+    futs += [b.submit(i) for i in range(1, 5)]
+    t0 = time.monotonic()
+    with pytest.raises(Overloaded):
+        b.submit(99)
+    assert time.monotonic() - t0 < 1.0
+    assert metrics.shed >= 1
+    release.set()
+    for f in futs:
+        f.result(timeout=10)
+    b.close()
+
+
+def test_submit_many_is_all_or_nothing():
+    b = MicroBatcher(lambda xs: xs, max_batch=2, max_wait_ms=1,
+                     queue_limit=4, start=False)
+    with pytest.raises(Overloaded):
+        b.submit_many(list(range(5)))
+    assert b.queue_depth == 0  # nothing partially admitted
+    futs = b.submit_many(list(range(4)))
+    b.close(drain=True)
+    assert [f.result(timeout=5) for f in futs] == [0, 1, 2, 3]
+
+
+def test_deadline_exceeded_is_typed_not_a_hang():
+    b = MicroBatcher(lambda xs: xs, max_batch=4, max_wait_ms=0, start=False)
+    f = b.submit("x", deadline_ms=1.0)
+    time.sleep(0.05)  # expire in queue before the worker ever runs
+    b.start()
+    with pytest.raises(DeadlineExceeded):
+        f.result(timeout=5)
+    b.close()
+
+
+def test_close_without_drain_fails_queued_typed():
+    b = MicroBatcher(lambda xs: xs, max_batch=4, max_wait_ms=0, start=False)
+    f = b.submit("x")
+    b.close(drain=False)
+    with pytest.raises(EngineClosed):
+        f.result(timeout=5)
+    with pytest.raises(EngineClosed):
+        b.submit("y")
+
+
+def test_graceful_drain_completes_all_queued():
+    seen = []
+
+    def forward(items):
+        seen.extend(items)
+        return items
+
+    b = MicroBatcher(forward, max_batch=3, max_wait_ms=1, start=False)
+    futs = [b.submit(i) for i in range(7)]
+    b.close(drain=True)  # starts, drains everything, joins
+    assert [f.result(timeout=5) for f in futs] == list(range(7))
+    assert sorted(seen) == list(range(7))
+
+
+def test_forward_error_fails_batch_but_keeps_serving():
+    flaky = {"fail": True}
+
+    def forward(items):
+        if flaky["fail"]:
+            raise RuntimeError("transient")
+        return items
+
+    b = MicroBatcher(forward, max_batch=2, max_wait_ms=1)
+    with pytest.raises(RuntimeError, match="transient"):
+        b.submit(1).result(timeout=5)
+    flaky["fail"] = False
+    assert b.submit(2).result(timeout=5) == 2
+    b.close()
+
+
+# ---- engine -----------------------------------------------------------------
+
+
+def test_engine_restores_and_predicts_native_size(engine):
+    image = np.random.default_rng(0).uniform(0, 1, (48, 40, 3)).astype(
+        np.float32
+    )
+    pred = engine.predict_classes(image, overlap=0.25, batch=4)
+    assert pred.shape == (48, 40)
+    assert pred.dtype == np.int32
+    assert pred.min() >= 0 and pred.max() < NCLASS
+
+
+def test_engine_matches_legacy_sliding_window(engine):
+    """predict.py and the serve engine share ONE tiling path: identical
+    logits for the same checkpoint and scene."""
+    image = np.random.default_rng(1).uniform(0, 1, (40, 56, 3)).astype(
+        np.float32
+    )
+    legacy = sliding_window_logits(
+        make_logits_fn(engine.model),
+        engine.state,
+        image,
+        TILE,
+        overlap=0.25,
+        batch=4,
+    )
+    got = engine.predict_logits(image, overlap=0.25, batch=4)
+    np.testing.assert_allclose(got, legacy, rtol=1e-5, atol=1e-5)
+
+
+def test_engine_jit_cache_buckets_batch_sizes(engine):
+    """Ragged batch sizes 1..8 compile at most the power-of-two buckets
+    (1, 2, 4, 8) per tile geometry — and a repeat pass compiles nothing."""
+    rng = np.random.default_rng(2)
+    for n in range(1, 9):
+        out = engine.forward_windows(
+            rng.uniform(0, 1, (n, *TILE, 3)).astype(np.float32)
+        )
+        assert out.shape == (n, *TILE, NCLASS)
+    first_pass = engine.compiled_shapes
+    assert first_pass <= 4
+    for n in range(1, 9):
+        engine.forward_windows(
+            rng.uniform(0, 1, (n, *TILE, 3)).astype(np.float32)
+        )
+    assert engine.compiled_shapes == first_pass
+    # warmup pre-compiles exactly these buckets — idempotent afterwards
+    assert engine.warmup() == first_pass
+
+
+def test_engine_hot_reload_swaps_params(tmp_path):
+    d = str(tmp_path / "run")
+    write_run(d, seed=0, step=1)
+    eng = InferenceEngine.from_workdir(d, echo=False)
+    x = np.random.default_rng(3).uniform(0, 1, (1, *TILE, 3)).astype(
+        np.float32
+    )
+    before = eng.forward_windows(x)
+    write_run(d, seed=7, step=2)  # newer checkpoint, different params
+    meta = eng.reload()
+    assert meta["step"] == 2
+    assert eng.version == 1
+    after = eng.forward_windows(x)
+    assert not np.allclose(before, after)  # params really swapped
+
+
+def test_hot_reload_mid_stream_never_errors(tmp_path):
+    """ISSUE 1 acceptance: params swap mid-stream; every request completes
+    with the old params' answer or the new — never an error."""
+    d = str(tmp_path / "run")
+    write_run(d, seed=0, step=1)
+    eng = InferenceEngine.from_workdir(d, echo=False)
+    x = np.random.default_rng(4).uniform(0, 1, (1, *TILE, 3)).astype(
+        np.float32
+    )
+    ref_old = eng.forward_windows(x)
+    write_run(d, seed=7, step=2)
+
+    cfg = ServeConfig(max_batch=2, max_wait_ms=2.0, queue_limit=256,
+                      deadline_ms=0.0)
+    frontend = ServingFrontend(eng, cfg)
+    errors, outputs = [], []
+    lock = threading.Lock()
+
+    def client():
+        for _ in range(6):
+            try:
+                out = frontend.batcher.submit(x[0]).result(timeout=30)
+            except Exception as e:  # noqa: BLE001 — the test asserts none
+                with lock:
+                    errors.append(e)
+            else:
+                with lock:
+                    outputs.append(np.asarray(out))
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.01)
+    eng.reload()  # swap mid-stream
+    for t in threads:
+        t.join()
+    frontend.close()
+    ref_new = eng.forward_windows(x)
+    assert errors == []
+    assert len(outputs) == 24
+    for out in outputs:
+        ok_old = np.allclose(out, ref_old[0], atol=1e-5)
+        ok_new = np.allclose(out, ref_new[0], atol=1e-5)
+        assert ok_old or ok_new  # one coherent version, never a mix
+    # The swap actually happened while requests were in flight for at least
+    # one version; (can't assert both versions observed — timing — but the
+    # engine must report the bump).
+    assert eng.version == 1
+
+
+def test_bucket_clips_to_non_pow2_cap():
+    from ddlpc_tpu.serve.engine import _bucket
+
+    assert _bucket(1, 5) == 1
+    assert _bucket(3, 5) == 4
+    assert _bucket(5, 5) == 5  # never exceeds the operator's cap
+    assert _bucket(8, 12) == 8
+    assert _bucket(12, 12) == 12
+
+
+def test_frontend_admits_scene_larger_than_queue(engine):
+    """A scene tiling into more windows than queue_limit streams through in
+    chunks — it must complete on an idle server, not shed permanently."""
+    cfg = ServeConfig(max_batch=4, max_wait_ms=1.0, queue_limit=8,
+                      deadline_ms=0.0, overlap=0.0)
+    frontend = ServingFrontend(engine, cfg)
+    image = np.random.default_rng(8).uniform(0, 1, (160, 160, 3)).astype(
+        np.float32
+    )  # 5×5 = 25 windows > queue_limit 8
+    pred = frontend.predict_classes(image)
+    frontend.close()
+    assert pred.shape == (160, 160)
+    snap = frontend.metrics.snapshot()
+    assert snap["requests"] == 1  # one scene request ...
+    assert snap["tiles"] == 25  # ... of 25 tiles: the rates differ
+
+
+# ---- metrics ----------------------------------------------------------------
+
+
+def test_metrics_snapshot_fields_and_quantiles():
+    m = ServeMetrics(window=128)
+    for ms in range(1, 101):
+        m.record_request(ms / 1000.0)
+    m.record_batch(3, 4)
+    m.record_shed()
+    m.record_deadline()
+    m.set_queue_depth(5)
+    snap = m.snapshot()
+    assert snap["kind"] == "serve"
+    assert 45 <= snap["p50_ms"] <= 55
+    assert 94 <= snap["p95_ms"] <= 96
+    assert 98 <= snap["p99_ms"] <= 100
+    assert snap["requests"] == 100
+    assert snap["shed"] == 1
+    assert snap["deadline_exceeded"] == 1
+    assert snap["queue_depth"] == 5
+    assert snap["batch_occupancy"] == 0.75
+    assert snap["requests_per_sec"] > 0
+
+
+def test_metrics_emit_rides_observability_jsonl(tmp_path):
+    from ddlpc_tpu.train.observability import MetricsLogger
+
+    m = ServeMetrics()
+    m.record_request(0.005)
+    logger = MetricsLogger(str(tmp_path), basename="serve_metrics")
+    m.emit(logger)
+    lines = (tmp_path / "serve_metrics.jsonl").read_text().splitlines()
+    rec = json.loads(lines[-1])
+    assert rec["kind"] == "serve" and rec["requests"] == 1
+    # the training stream file is untouched
+    assert not (tmp_path / "metrics.jsonl").exists()
+
+
+# ---- config -----------------------------------------------------------------
+
+
+def test_serve_config_roundtrip_and_unknown_key():
+    cfg = ServeConfig(max_batch=16, deadline_ms=500.0)
+    assert ServeConfig.from_json(cfg.to_json()) == cfg
+    with pytest.raises(ValueError, match="unknown config key"):
+        ServeConfig.from_dict({"max_batchez": 1})
+
+
+def test_serve_vaihingen_config_parses():
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "configs", "serve_vaihingen.json"
+    )
+    with open(path) as f:
+        cfg = ServeConfig.from_json(f.read())
+    assert cfg.max_batch >= 1 and cfg.queue_limit >= cfg.max_batch
+
+
+# ---- HTTP server ------------------------------------------------------------
+
+
+@pytest.fixture()
+def http_frontend(engine):
+    cfg = ServeConfig(max_batch=4, max_wait_ms=2.0, queue_limit=64,
+                      deadline_ms=5000.0)
+    frontend = ServingFrontend(engine, cfg)
+    server = make_server(frontend, "127.0.0.1", 0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield server, frontend
+    server.shutdown()
+    frontend.close()
+    server.server_close()
+    thread.join(timeout=5)
+
+
+def _request(port, method, path, body=None, headers=None):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def test_http_healthz_metrics_predict_reload(http_frontend):
+    server, frontend = http_frontend
+    port = server.server_address[1]
+
+    status, body = _request(port, "GET", "/healthz")
+    assert status == 200
+    health = json.loads(body)
+    assert health["status"] == "ok"
+    assert health["tile"] == list(TILE)
+
+    image = np.random.default_rng(5).uniform(0, 1, (40, 48, 3)).astype(
+        np.float32
+    )
+    buf = io.BytesIO()
+    np.save(buf, image)
+    status, body = _request(
+        port, "POST", "/predict", body=buf.getvalue(),
+        headers={"Content-Type": "application/x-npy"},
+    )
+    assert status == 200
+    pred = np.load(io.BytesIO(body), allow_pickle=False)
+    assert pred.shape == (40, 48)
+    assert pred.max() < NCLASS
+
+    status, body = _request(port, "GET", "/metrics")
+    snap = json.loads(body)
+    assert status == 200 and snap["requests"] >= 1 and snap["p50_ms"] > 0
+
+    status, body = _request(port, "POST", "/reload", body=b"{}")
+    assert status == 200
+    assert json.loads(body)["step"] == 1
+
+    status, body = _request(port, "POST", "/predict", body=b"garbage")
+    assert status == 400
+
+    status, _ = _request(port, "GET", "/nope")
+    assert status == 404
+
+
+def test_serve_bench_smoke_end_to_end():
+    """scripts/serve_bench.py runs on the CPU backend in CI budget and
+    reports the driver-contract JSON line from the serving metrics stream."""
+    import subprocess
+    import sys as _sys
+
+    script = os.path.join(
+        os.path.dirname(__file__), "..", "scripts", "serve_bench.py"
+    )
+    proc = subprocess.run(
+        [
+            _sys.executable, script,
+            "--clients", "2", "--requests", "6", "--scene", "40",
+            "--max-batch", "4",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "serve_p99_ms"
+    assert rec["value"] > 0
+    assert rec["p50_ms"] > 0
+    assert rec["tiles_per_sec"] > 0
+    assert rec["errors"] == 0
+    assert rec["vs_baseline"] is not None
+
+
+def test_http_predict_rejects_wrong_channels(http_frontend):
+    server, _ = http_frontend
+    port = server.server_address[1]
+    buf = io.BytesIO()
+    np.save(buf, np.zeros((16, 16, 5), np.float32))
+    status, body = _request(port, "POST", "/predict", body=buf.getvalue())
+    assert status == 400
+    assert "channels" in json.loads(body)["error"]

@@ -244,11 +244,16 @@ def test_configs_dir_parses():
     config system."""
     import glob
 
-    from ddlpc_tpu.config import ExperimentConfig
+    from ddlpc_tpu.config import ExperimentConfig, ServeConfig
 
     paths = sorted(glob.glob(os.path.join(os.path.dirname(__file__), "..", "configs", "*.json")))
-    assert len(paths) == 7  # 5 BASELINE parity + TPU flagship + s2d U-Net++
+    # 5 BASELINE parity + TPU flagship + s2d U-Net++ + serving deploy
+    assert len(paths) == 8
     for p in paths:
+        if os.path.basename(p).startswith("serve_"):
+            # serve_*.json are ServeConfig deploy artifacts, not experiments
+            ServeConfig.from_json(open(p).read())
+            continue
         cfg = ExperimentConfig.from_json(open(p).read())
         assert cfg.model.num_classes == cfg.data.num_classes
 

@@ -120,6 +120,59 @@ def test_python_native_interop():
         wire._native = old
 
 
+def test_empty_payload_roundtrip(backend):
+    """b'' is a valid zero-block frame, not an error, on both backends."""
+    frame = wire.compress(b"")
+    assert wire.decompress(frame) == b""
+    import struct
+
+    assert frame[:4] == wire.MAGIC
+    (nblk,) = struct.unpack_from("<I", frame, 4)
+    assert nblk == 0
+
+
+def test_multiblock_frame_splits_at_block_size(backend):
+    """A payload one byte past 2·BLOCK_SIZE must produce exactly 3
+    independently-deflated blocks and roundtrip bit-exactly."""
+    import struct
+
+    data = (b"multiblock" * (2 * wire.BLOCK_SIZE // 10 + 1))[
+        : 2 * wire.BLOCK_SIZE + 1
+    ]
+    frame = wire.compress(data)
+    (nblk,) = struct.unpack_from("<I", frame, 4)
+    assert nblk == 3
+    assert wire.decompress(frame) == data
+
+
+@pytest.mark.parametrize(
+    "size", [0, 1, wire.BLOCK_SIZE + 1, 2 * wire.BLOCK_SIZE + 17]
+)
+def test_python_native_parity_edge_sizes(size):
+    """Empty and multi-block frames cross-decode between the pure-Python
+    path and csrc/wire.cc byte-compatibly (each side decodes the other's
+    frame; skipped where the native lib cannot build)."""
+    nw = native.load()
+    if nw is None:
+        pytest.skip("native codec not buildable here")
+    rng = np.random.default_rng(size)
+    data = rng.integers(0, 32, size, dtype=np.uint8).tobytes()
+    old = wire._native
+    try:
+        wire._native = False
+        py_frame = wire.compress(data)
+        assert nw.decompress(py_frame) == data
+        native_frame = nw.compress(data, wire.LEVEL, wire.BLOCK_SIZE)
+        assert wire.decompress(native_frame) == data
+    finally:
+        wire._native = old
+
+
+def test_message_framing_empty_payload():
+    got, rest = wire.unpack_message(wire.pack_message(b""))
+    assert got == b"" and rest == b""
+
+
 def test_message_framing_roundtrip():
     payload = os.urandom(1000)
     buf = wire.pack_message(payload) + b"rest"
