@@ -379,7 +379,7 @@ def main() -> None:
         # Deadline-bounded backend probe: a wedged device tunnel blocks
         # jax.devices() FOREVER (observed mid-round-4); an explicit error
         # line beats an infinite hang for any harness driving this.
-        from ddlpc_tpu.utils.backend_probe import probe_backend
+        from ddlpc_tpu.utils.backend_probe import probe_backend, probe_bound_s
 
         result = probe_backend(300.0)
         if result is None or isinstance(result, Exception):
@@ -395,7 +395,8 @@ def main() -> None:
                             "backend init failed — device tunnel "
                             f"unreachable ({result!r})"
                             if result is not None else
-                            "backend init timed out after 300 s — device "
+                            f"backend init timed out after "
+                            f"{probe_bound_s(300.0):.0f} s — device "
                             "tunnel unreachable"
                         ),
                     }

@@ -147,7 +147,11 @@ class DataConfig:
     # Host-side threads for the ShardedLoader's gather/cast/upload
     # pipeline (SURVEY §7 hard part (c)): numpy's large copies/casts and
     # the device upload release the GIL, so >1 scales with cores on a pod
-    # host.  Batch content and order are identical for any value.
+    # host.  Batch content and order are identical for any value.  NOTE:
+    # the loader keeps max(prefetch, workers)+1 super-batches in flight
+    # (workers below prefetch would idle), so workers above the default
+    # prefetch=2 grow the number of UPLOADED batches resident in HBM —
+    # budget accordingly on memory-tight configs.
     loader_workers: int = 1
     # Upload the whole train set to HBM once and gather batches on device
     # (single-process, fixed-tile datasets that fit HBM — ISPRS scale is
@@ -187,6 +191,24 @@ class TrainConfig:
     log_every_steps: int = 1
     checkpoint_every_epochs: int = 1
     keep_checkpoints: int = 3
+    # Checkpoint subsystem (train/checkpoint.py, docs/CHECKPOINTS.md).
+    # checkpoint_async hands the write (chunk → compress → fsync → prune)
+    # to a background thread so the next epoch overlaps the I/O; the
+    # training thread pays only the host snapshot, with a barrier on the
+    # next save/exit and writer failures re-raised on the training thread
+    # (train/async_checkpoint.py).
+    checkpoint_async: bool = True
+    # 'chunked' streams per-leaf bounded chunks through the DWZ1 codec
+    # (no whole-state bytes copy; parallel save AND restore);
+    # 'monolithic' is the legacy single-msgpack-blob writer.  Both restore
+    # through the same reader regardless of this knob.
+    checkpoint_format: str = "chunked"  # chunked | monolithic
+    checkpoint_chunk_mb: int = 4  # raw MB per compression/IO unit
+    # 'adaptive' probes each chunk and STORES entropy-dense fp32 weights
+    # (~memcpy speed) while still deflating compressible tensors;
+    # 'always' deflates everything at the wire level; 'store' never
+    # deflates (fastest, largest).
+    checkpoint_compression: str = "adaptive"  # adaptive | always | store
     eval_every_epochs: int = 1
     dump_images_per_epoch: int = 5  # qualitative PNG triples (кластер.py:785-790)
     # Rematerialize each micro-batch's forward during backward

@@ -405,6 +405,16 @@ def load_scene_dir(
                         f"{img_path}: array images must be [H, W, "
                         f"{channels}], got shape {img.shape}"
                     )
+                if img.dtype != np.uint8:
+                    # Same contract as the mmap branch and _read_tile: the
+                    # prepare_* converters write uint8, and _finish_image
+                    # divides by 255 — an already-float scene would be
+                    # silently normalized TWICE (ADVICE r5).
+                    raise ValueError(
+                        f"{img_path}: array images must be uint8 (float "
+                        f"scenes would be /255-normalized twice), got "
+                        f"{img.dtype}"
+                    )
                 img = _finish_image(img, None, channels, normalize)
         elif mmap:
             raise ValueError(

@@ -227,7 +227,11 @@ class ShardedLoader(_EpochSampler):
         are yielded strictly in submission order, and each batch is a pure
         function of its index chunk.  An exception in any worker surfaces
         at that batch's position; an early consumer ``break`` waits only
-        for the ≤ prefetch+1 already-submitted short tasks.
+        for the ≤ max(prefetch, workers)+1 already-submitted short tasks —
+        the in-flight depth covers the worker count (see below), not just
+        ``prefetch``, so ``workers > prefetch`` raises the number of
+        uploaded super-batches resident in HBM accordingly
+        (DataConfig.loader_workers documents the budget implication).
         """
         if self.prefetch <= 0:
             for flat in self._super_batch_index_chunks():

@@ -9,7 +9,10 @@ class-map PNG per input.
 This is now a thin client of :mod:`ddlpc_tpu.serve.engine`: the tiler and
 restore logic live there (one tested path shared with the serving engine);
 ``sliding_window_logits`` and ``load_run`` stay re-exported here for
-existing callers.
+existing callers.  Restore goes through the format-dispatching checkpoint
+reader (train/checkpoint.py): both the chunked ``.dwc`` format and legacy
+single-blob ``.msgpack.z`` checkpoints load here unchanged
+(docs/CHECKPOINTS.md).
 """
 
 from __future__ import annotations

@@ -193,6 +193,7 @@ class InferenceEngine:
         self.max_bucket = max(1, int(max_bucket))
         self.version = 0
         self.checkpoint_step: Optional[int] = None
+        self.last_restore_s: Optional[float] = None
         self._lock = threading.Lock()
         self._state = state
         # (batch_bucket, th, tw, c) -> jitted logits fn.  Each key owns its
@@ -260,18 +261,39 @@ class InferenceEngine:
         The restore happens OFF-lock against the current state's structure;
         only the final reference swap takes the lock, so in-flight forwards
         (which snapshotted the old reference) are never torn mid-call.
+        Restores either checkpoint format through the one dispatching
+        reader (train/checkpoint.py): a trainer that switched to the
+        chunked writer hot-reloads into a serving engine started on a
+        legacy blob, and vice versa.  The returned metadata gains
+        ``restore_seconds``/``restore_format`` so the /reload response
+        shows what the swap actually cost.
         """
+        import time as _time
+
         from ddlpc_tpu.train import checkpoint as ckpt
 
         workdir = workdir or self.workdir
         if workdir is None:
             raise ValueError("no workdir to reload from")
         ckpt_dir = os.path.join(workdir, "checkpoints")
+        t0 = _time.perf_counter()
         state, meta = ckpt.restore_checkpoint(ckpt_dir, self.state, step=step)
+        restore_s = _time.perf_counter() - t0
+        resolved = meta.get("step") if meta.get("step") is not None else step
+        fmt = None
+        if resolved is not None:
+            try:
+                _, fmt = ckpt.checkpoint_path(ckpt_dir, int(resolved))
+            except FileNotFoundError:
+                pass  # pruned between restore and stat — timing still valid
         with self._lock:
             self._state = state
             self.version += 1
             self.checkpoint_step = meta.get("step")
+            self.last_restore_s = restore_s
+        meta = dict(meta, restore_seconds=round(restore_s, 4))
+        if fmt is not None:
+            meta["restore_format"] = fmt
         return meta
 
     # ---- compiled forward --------------------------------------------------

@@ -152,6 +152,8 @@ class ServingFrontend:
                     "kind": "serve_reload",
                     "version": self.engine.version,
                     "step": meta.get("step"),
+                    "restore_seconds": meta.get("restore_seconds"),
+                    "restore_format": meta.get("restore_format"),
                 },
                 echo=False,
             )
@@ -289,7 +291,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(
                 200,
                 {"version": self.frontend.engine.version,
-                 "step": meta.get("step")},
+                 "step": meta.get("step"),
+                 # What the swap cost and which on-disk format served it
+                 # (train/checkpoint.py dispatching reader).
+                 "restore_seconds": meta.get("restore_seconds"),
+                 "restore_format": meta.get("restore_format")},
             )
 
 

@@ -1,0 +1,146 @@
+"""Asynchronous checkpointing: take save I/O off the training critical path.
+
+The synchronous save stalls the epoch loop for the full device_get →
+chunk → compress → fsync chain; at pod scale that stall dominates
+(PAPERS: "Scalable Training of Language Models using JAX pjit and
+TPUv4" overlaps checkpoint I/O with compute for exactly this reason).
+Here the training thread pays ONLY for the host snapshot
+(checkpoint.snapshot_state — a bounded memcpy of the state, required for
+correctness anyway because the next step may reuse donated buffers);
+everything downstream (chunking, compression, fsync, prune) runs on one
+background writer thread.
+
+Semantics (tests/test_checkpoint_format.py):
+
+- **Ordering barrier**: a save issued while the previous write is still
+  in flight first waits for it — checkpoints hit disk strictly in issue
+  order and at most one write is ever in flight (bounded memory: one
+  snapshot).
+- **Exceptions surface on the training thread**: a writer failure (disk
+  full, permission) is re-raised by the next ``save()`` or ``wait()``,
+  never swallowed — training must not run for hours believing it is
+  checkpointed.
+- **Exit barrier**: callers must ``wait()`` (or use the context manager)
+  before treating the run as checkpointed; ``Trainer.fit`` barriers
+  after the epoch loop, inside ``watchdog.paused`` (write time is
+  unrelated to the step-sized stall timeout).
+- **Process gate**: non-zero processes no-op on ``save`` (state is
+  replicated; only process 0 writes), matching ``save_checkpoint``.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import time
+from typing import Optional
+
+import jax
+
+from ddlpc_tpu.train import checkpoint as ckpt
+
+
+class AsyncCheckpointer:
+    """Background-threaded ``save_checkpoint`` with sync fallback.
+
+    ``background=False`` runs the identical write inline (same format,
+    same snapshot path) — the knob ``TrainConfig.checkpoint_async`` maps
+    here, so an A/B between the modes differs only in WHERE the write
+    runs, never in what lands on disk.
+    """
+
+    def __init__(
+        self,
+        keep: int = 3,
+        format: str = "chunked",
+        chunk_bytes: int = ckpt.CHUNK_BYTES,
+        compression: str = "adaptive",
+        background: bool = True,
+    ):
+        self.keep = keep
+        self.format = format
+        self.chunk_bytes = chunk_bytes
+        self.compression = compression
+        self.background = background
+        self._pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+        self._inflight: Optional[concurrent.futures.Future] = None
+        # Observability: what the TRAINING thread paid for the last save
+        # (snapshot + any barrier on the previous write) vs what the write
+        # actually cost in the background.
+        self.last_stall_s = 0.0
+        self.last_write_s = 0.0
+        self.saves = 0
+
+    def _executor(self) -> concurrent.futures.ThreadPoolExecutor:
+        if self._pool is None:
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                1, thread_name_prefix="ckpt-writer"
+            )
+        return self._pool
+
+    # -- core ---------------------------------------------------------------
+
+    def save(
+        self,
+        ckpt_dir: str,
+        state,
+        step: int,
+        metadata: Optional[dict] = None,
+    ) -> None:
+        """Snapshot ``state`` and schedule (or perform) the write.
+
+        Blocks only for the host snapshot — plus, if the previous write
+        is still running, a barrier on it (which also re-raises its
+        failure here, on the training thread).
+        """
+        t0 = time.perf_counter()
+        self.wait()
+        if jax.process_index() != 0:
+            self.last_stall_s = time.perf_counter() - t0
+            return
+        snap = ckpt.snapshot_state(state)
+
+        def write():
+            w0 = time.perf_counter()
+            ckpt.save_snapshot(
+                ckpt_dir,
+                snap,
+                step,
+                metadata=metadata,
+                keep=self.keep,
+                format=self.format,
+                chunk_bytes=self.chunk_bytes,
+                compression=self.compression,
+            )
+            self.last_write_s = time.perf_counter() - w0
+
+        if self.background:
+            self._inflight = self._executor().submit(write)
+        else:
+            write()
+        self.saves += 1
+        self.last_stall_s = time.perf_counter() - t0
+
+    def wait(self) -> None:
+        """Barrier on the in-flight write; re-raises its exception here."""
+        inflight, self._inflight = self._inflight, None
+        if inflight is not None:
+            inflight.result()
+
+    @property
+    def in_flight(self) -> bool:
+        return self._inflight is not None and not self._inflight.done()
+
+    def close(self) -> None:
+        """Final barrier + writer-thread shutdown (idempotent)."""
+        try:
+            self.wait()
+        finally:
+            if self._pool is not None:
+                self._pool.shutdown(wait=True)
+                self._pool = None
+
+    def __enter__(self) -> "AsyncCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

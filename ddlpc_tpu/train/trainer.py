@@ -41,6 +41,7 @@ from ddlpc_tpu.parallel.train_step import (
     make_train_step_gspmd,
 )
 from ddlpc_tpu.train import checkpoint as ckpt
+from ddlpc_tpu.train.async_checkpoint import AsyncCheckpointer
 from ddlpc_tpu.train.observability import (
     MetricsLogger,
     StageTimer,
@@ -199,6 +200,17 @@ class Trainer:
             timeout_s=cfg.train.stall_timeout_s,
             action=cfg.train.stall_action,
             log_path=os.path.join(self.workdir, "stall.log"),
+        )
+        # Async by default: save() pays only the host snapshot; the chunk/
+        # compress/fsync chain overlaps the next epoch's compute on a
+        # writer thread, with a barrier (and error re-raise) on the next
+        # save and at the end of fit() (train/async_checkpoint.py).
+        self.checkpointer = AsyncCheckpointer(
+            keep=cfg.train.keep_checkpoints,
+            format=cfg.train.checkpoint_format,
+            chunk_bytes=max(1, cfg.train.checkpoint_chunk_mb) << 20,
+            compression=cfg.train.checkpoint_compression,
+            background=cfg.train.checkpoint_async,
         )
 
     def _build_train_step(self):
@@ -376,7 +388,7 @@ class Trainer:
         )
 
     def save(self, epoch: int) -> None:
-        ckpt.save_checkpoint(
+        self.checkpointer.save(
             self.ckpt_dir,
             self.state,
             step=int(jax.device_get(self.state.step)),
@@ -387,7 +399,6 @@ class Trainer:
                 # channels come from the dataset, not the config (ADVICE r1).
                 "input_channels": int(self.train_ds.image_shape[-1]),
             },
-            keep=self.cfg.train.keep_checkpoints,
         )
 
     def fit(self, epochs: Optional[int] = None) -> Dict[str, float]:
@@ -405,25 +416,39 @@ class Trainer:
             self.train_step = self._build_train_step()
         record: Dict[str, float] = {}
         with self.watchdog:
-            for epoch in range(self.start_epoch, epochs):
-                with maybe_profile(
-                    os.path.join(self.workdir, "profile"),
-                    enabled=epoch == cfg.profile_epoch,
-                ):
-                    record = self.train_epoch(epoch)
-                if cfg.eval_every_epochs and (epoch + 1) % cfg.eval_every_epochs == 0:
-                    # evaluate() beats per batch; per-batch eval cost is
-                    # step-like, so the step-sized timeout applies.
-                    record.update(self.evaluate())
-                self.logger.log(record)
-                if cfg.checkpoint_every_epochs and (
-                    epoch + 1
-                ) % cfg.checkpoint_every_epochs == 0:
-                    # Serialization/IO time is unrelated to the step-sized
-                    # timeout — suspend detection rather than mis-size it.
-                    with self.watchdog.paused("checkpoint"):
-                        self.save(epoch)
-                if cfg.dump_images_per_epoch:
-                    with self.watchdog.paused("image_dump"):
-                        self.dump_images(epoch)
+            try:
+                for epoch in range(self.start_epoch, epochs):
+                    with maybe_profile(
+                        os.path.join(self.workdir, "profile"),
+                        enabled=epoch == cfg.profile_epoch,
+                    ):
+                        record = self.train_epoch(epoch)
+                    if cfg.eval_every_epochs and (epoch + 1) % cfg.eval_every_epochs == 0:
+                        # evaluate() beats per batch; per-batch eval cost is
+                        # step-like, so the step-sized timeout applies.
+                        record.update(self.evaluate())
+                    self.logger.log(record)
+                    if cfg.checkpoint_every_epochs and (
+                        epoch + 1
+                    ) % cfg.checkpoint_every_epochs == 0:
+                        # Snapshot/serialization time is unrelated to the
+                        # step-sized timeout — suspend detection rather than
+                        # mis-size it.  Under checkpoint_async this blocks
+                        # only for the host snapshot (plus a barrier if the
+                        # PREVIOUS write is somehow still running); the write
+                        # itself overlaps the next epoch.
+                        with self.watchdog.paused("checkpoint"):
+                            self.save(epoch)
+                    if cfg.dump_images_per_epoch:
+                        with self.watchdog.paused("image_dump"):
+                            self.dump_images(epoch)
+            finally:
+                # Exit barrier: fit() must not return (or unwind) with a
+                # checkpoint still in flight — this also re-raises a writer
+                # failure on the training thread.  close() additionally
+                # shuts the writer thread down (one leaked non-daemon
+                # thread per Trainer otherwise); a later save()/fit() on
+                # this Trainer transparently respawns it.
+                with self.watchdog.paused("checkpoint_flush"):
+                    self.checkpointer.close()
         return record

@@ -18,14 +18,26 @@ import jax
 
 ProbeResult = Union[Sequence[jax.Device], Exception, None]
 
+GRACE_S = 5.0  # default post-deadline re-check window
 
-def probe_backend(deadline_s: float, grace_s: float = 5.0) -> ProbeResult:
+
+def probe_bound_s(deadline_s: float, grace_s: float = GRACE_S) -> float:
+    """The WORST-CASE wall time :func:`probe_backend` may block: the
+    deadline plus the grace re-check.  Callers reporting "timed out after
+    N s" must use this bound, not ``deadline_s`` alone — the messages
+    previously under-reported the wait by ``grace_s`` (ADVICE r5)."""
+    return deadline_s + (grace_s if grace_s > 0 else 0.0)
+
+
+def probe_backend(deadline_s: float, grace_s: float = GRACE_S) -> ProbeResult:
     """``jax.devices()`` with a deadline, off-thread.
 
     Returns the device list on success, the raised ``Exception`` on init
     failure, or ``None`` if init was still blocked after ``deadline_s``
     (+ one ``grace_s`` re-check, because the daemon thread may finish init
     just after the deadline — the probe is advisory, not a cancellation).
+    The total blocking bound is therefore :func:`probe_bound_s`, which is
+    what any user-facing timeout message should quote.
     The probing thread is a daemon: a hung init cannot keep the process
     alive, but it may complete concurrently after this returns.
     """

@@ -1,0 +1,229 @@
+"""Checkpoint subsystem benchmark: chunked-parallel vs monolithic format,
+async vs sync train-loop stall — the committed evidence for ISSUE 3's perf
+claim (save ≥ 2× MB/s on a ≥ 100 MB state; async stall < 10% of sync).
+
+Pure host-side work, honest on CPU (VERDICT r5 asked for chip-free perf
+evidence): the measured chain is exactly what a TPU host runs — host
+snapshot → per-leaf chunking → DWZ1 deflate/store → fsync — only the
+device_get source differs.
+
+The synthetic state mimics a trained segmentation net + Adam: ~2/3 of the
+bytes are entropy-dense float32 (trained weights / second moments — the
+worst case for any compressor), ~1/3 compressible (embedding-like rows,
+zeroed slots).  Results → JSON artifact (default
+docs/checkpoint_bench/checkpoint_bench.json) plus a driver-contract line:
+
+    checkpoint_bench: save_speedup=... stall_ratio=...
+
+Usage:
+    python scripts/checkpoint_bench.py [--size-mb 128] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddlpc_tpu.train import checkpoint as ckpt  # noqa: E402
+from ddlpc_tpu.train.async_checkpoint import AsyncCheckpointer  # noqa: E402
+
+
+def build_state(size_mb: int, seed: int = 0) -> dict:
+    """Synthetic TrainState-shaped pytree of about ``size_mb`` MB."""
+    rng = np.random.default_rng(seed)
+    total = size_mb << 20
+    dense = int(total * 0.65) // 4  # trained weights + Adam nu: noise
+    comp = total - dense * 4
+    params, opt = {}, {}
+    i = 0
+    remaining = dense
+    while remaining > 0:
+        n = min(remaining, (8 << 20) // 4)
+        params[f"conv_{i}"] = rng.standard_normal(n, dtype=np.float32) * 0.05
+        remaining -= n
+        i += 1
+    # Compressible third: zeros (fresh Adam mu), low-entropy int8-ish
+    # quantized residuals, and repeated rows.
+    opt["mu"] = np.zeros(comp // 8, np.float32)
+    opt["quantized"] = (
+        rng.integers(-10, 11, comp // 8, dtype=np.int32).astype(np.float32)
+    )
+    opt["rows"] = np.tile(
+        rng.standard_normal(1024, dtype=np.float32), comp // 4 // 2 // 1024
+    )
+    state = {"params": params, "opt_state": opt, "step": np.int64(12345)}
+    return state
+
+
+def state_bytes(state) -> int:
+    return sum(
+        a.nbytes for a in ckpt.snapshot_state(state).values()
+        if isinstance(a, np.ndarray)
+    )
+
+
+def timed_save(d: str, state, fmt: str, **kw) -> float:
+    shutil.rmtree(d, ignore_errors=True)
+    t0 = time.perf_counter()
+    ckpt.save_checkpoint(d, state, step=1, keep=1, format=fmt, **kw)
+    return time.perf_counter() - t0
+
+
+def timed_restore(d: str, target) -> float:
+    t0 = time.perf_counter()
+    ckpt.restore_checkpoint(d, target)
+    return time.perf_counter() - t0
+
+
+def measure_stall(
+    d: str, state, background: bool, steps: int = 4, step_s: float = 0.35
+) -> dict:
+    """Fake epoch loop: ``steps`` sleeps (device compute releasing the GIL)
+    with a save after each — returns the mean time save() blocked the loop
+    thread and the loop's total wall clock.  ``step_s`` must exceed the
+    write time (checkpoint cadence is per-EPOCH; an epoch shorter than one
+    checkpoint write is not an operating point) or the async path
+    degenerates into barrier waits — main() sizes it from the measured
+    save time."""
+    shutil.rmtree(d, ignore_errors=True)
+    stalls = []
+    with AsyncCheckpointer(keep=2, background=background) as ac:
+        # Steady-state measurement: the first save pays one-time costs
+        # (writer/codec pool spin-up) that a 100-epoch run amortizes away;
+        # warm them up uncounted, like every compile-sensitive bench here.
+        ac.save(d, state, step=0)
+        ac.wait()
+        t_loop = time.perf_counter()
+        for i in range(1, steps + 1):
+            time.sleep(step_s)  # the "epoch compute" the write overlaps
+            t0 = time.perf_counter()
+            ac.save(d, state, step=i)
+            stalls.append(time.perf_counter() - t0)
+        t_flush = time.perf_counter()
+        ac.wait()
+        flush_s = time.perf_counter() - t_flush
+    wall = time.perf_counter() - t_loop
+    return {
+        "mean_save_block_ms": float(np.mean(stalls) * 1e3),
+        "max_save_block_ms": float(np.max(stalls) * 1e3),
+        "exit_flush_ms": flush_s * 1e3,
+        "loop_wall_s": wall,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--size-mb", type=int, default=128)
+    p.add_argument("--rounds", type=int, default=3)
+    p.add_argument(
+        "--out",
+        default=os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "docs", "checkpoint_bench", "checkpoint_bench.json",
+        ),
+    )
+    p.add_argument("--workdir", default=None, help="scratch dir (default: tmp)")
+    args = p.parse_args(argv)
+
+    state = build_state(args.size_mb)
+    raw_mb = state_bytes(state) / (1 << 20)
+    scratch = args.workdir or tempfile.mkdtemp(prefix="ckpt_bench_")
+    d = os.path.join(scratch, "ck")
+    # Same structure as the saved tree = a valid restore target.
+    target = ckpt._unflatten(ckpt.snapshot_state(state))
+
+    results: dict = {
+        "state_mb": round(raw_mb, 1),
+        "cpu_count": os.cpu_count(),
+        "chunk_mb": ckpt.CHUNK_BYTES >> 20,
+        "formats": {},
+    }
+    for fmt, kw in (
+        ("monolithic", {}),
+        ("chunked", {"compression": "adaptive"}),
+        ("chunked_always_deflate", {"compression": "always"}),
+    ):
+        real_fmt = "chunked" if fmt.startswith("chunked") else fmt
+        saves, restores = [], []
+        for _ in range(args.rounds):
+            saves.append(timed_save(d, state, real_fmt, **kw))
+            restores.append(timed_restore(d, target))
+        blob = ckpt.checkpoint_path(d, 1)[0]
+        results["formats"][fmt] = {
+            "save_s": round(min(saves), 3),
+            "restore_s": round(min(restores), 3),
+            "save_mb_s": round(raw_mb / min(saves), 1),
+            "restore_mb_s": round(raw_mb / min(restores), 1),
+            "blob_mb": round(os.path.getsize(blob) / (1 << 20), 1),
+        }
+        print(f"{fmt:>24}: {results['formats'][fmt]}", flush=True)
+
+    # Old-vs-new cross-restore sanity: the chunked reader must reproduce
+    # the monolithic writer's state bit-for-bit and vice versa.
+    shutil.rmtree(d, ignore_errors=True)
+    ckpt.save_checkpoint(d, state, step=1, keep=2, format="monolithic")
+    old, _ = ckpt.restore_checkpoint(d, target, step=1)
+    ckpt.save_checkpoint(d, state, step=2, keep=2, format="chunked")
+    new, _ = ckpt.restore_checkpoint(d, target, step=2)
+    flat_old = ckpt.snapshot_state(old)
+    flat_new = ckpt.snapshot_state(new)
+    identical = all(
+        np.array_equal(flat_old[k], flat_new[k], equal_nan=True)
+        if isinstance(flat_old[k], np.ndarray) else flat_old[k] == flat_new[k]
+        for k in flat_old
+    )
+    results["old_new_restore_bit_identical"] = bool(identical)
+
+    # Compute window sized above the measured write time: checkpoints are
+    # per-epoch, and the interesting regime is epoch > write (otherwise
+    # the writer itself, not the stall, is the bottleneck either way).
+    step_s = max(0.3, 1.3 * results["formats"]["chunked"]["save_s"])
+    sync = measure_stall(d, state, background=False, step_s=step_s)
+    async_ = measure_stall(d, state, background=True, step_s=step_s)
+    ratio = async_["mean_save_block_ms"] / max(sync["mean_save_block_ms"], 1e-9)
+    results["stall"] = {
+        "sync": sync,
+        "async": async_,
+        "async_over_sync_block_ratio": round(ratio, 4),
+    }
+    mono = results["formats"]["monolithic"]
+    chunk = results["formats"]["chunked"]
+    results["save_speedup_chunked_vs_monolithic"] = round(
+        chunk["save_mb_s"] / mono["save_mb_s"], 2
+    )
+    results["restore_speedup_chunked_vs_monolithic"] = round(
+        chunk["restore_mb_s"] / mono["restore_mb_s"], 2
+    )
+
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=2)
+    if args.workdir is None:
+        shutil.rmtree(scratch, ignore_errors=True)
+    print(
+        f"checkpoint_bench: save_speedup="
+        f"{results['save_speedup_chunked_vs_monolithic']} "
+        f"stall_ratio={results['stall']['async_over_sync_block_ratio']} "
+        f"-> {args.out}",
+        flush=True,
+    )
+    ok = (
+        results["save_speedup_chunked_vs_monolithic"] >= 2.0
+        and ratio < 0.10
+        and identical
+    )
+    print(f"checkpoint_bench_pass={ok}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,9 +27,36 @@ export PYTHONPATH=/root/repo:/root/.axon_site
 cd /root/repo
 # Self-enforce the single-queue precondition: retire the superseded
 # pollers so three queues can never drive the one chip concurrently.
-# (The patterns cannot match this script's own _v2 name.)
-pkill -f 'run_tpu_backlog\.sh' 2>/dev/null
-pkill -f 'run_tpu_backlog2\.sh' 2>/dev/null
+# (The patterns cannot match this script's own _v2 name.)  Killing just
+# the poller scripts is not enough: a python arm they already launched
+# (via `timeout NNN python ...`) keeps driving the chip orphaned — kill
+# each old queue's whole process tree, then WAIT for it to drain before
+# the v2 arms start.
+for pat in 'run_tpu_backlog\.sh' 'run_tpu_backlog2\.sh'; do
+  for pid in $(pgrep -f "$pat"); do
+    # Children first (the `timeout` wrappers forward TERM to their
+    # python child), then the poller itself.
+    pkill -TERM -P "$pid" 2>/dev/null
+    kill -TERM "$pid" 2>/dev/null
+  done
+done
+for i in $(seq 1 30); do
+  pgrep -f 'run_tpu_backlog\.sh|run_tpu_backlog2\.sh' > /dev/null || break
+  sleep 1
+done
+# Last resort for arms that detached from their poller (double-fork /
+# setsid) or outlived a killed `timeout` wrapper: sweep BOTH the wrapper
+# cmdline and the bare python child cmdline — SIGKILL is never forwarded,
+# so killing only the wrapper would re-parent a TERM-resistant arm (e.g.
+# wedged in a device call) and leave it driving the chip with its timeout
+# bound gone.  Quoted single tokens, so the queue-lint test's shlex scan
+# never mistakes these for runnable arms; v2's own arms have not started
+# yet, so nothing here can self-match.
+pkill -TERM -f 'timeout [0-9]+ python (bench\.py|scripts/)' 2>/dev/null
+pkill -TERM -f '^python (bench\.py|scripts/)' 2>/dev/null
+sleep 3
+pkill -KILL -f 'timeout [0-9]+ python (bench\.py|scripts/)' 2>/dev/null
+pkill -KILL -f '^python (bench\.py|scripts/)' 2>/dev/null
 for i in $(seq 1 400); do
   if timeout 90 python -c "import jax; assert jax.devices()" > /dev/null 2>&1; then
     echo "TUNNEL UP after $i polls $(date)"

@@ -634,6 +634,31 @@ def test_mmap_scenes_config_validation_and_grid_tiles(tmp_path):
     np.testing.assert_array_equal(tiles_u8.images, tiles_f32.images)
 
 
+def test_load_scene_dir_eager_npy_rejects_non_uint8(tmp_path):
+    """The eager npy-scene branch must reject float scenes like the mmap
+    branch and _read_tile do: an already-normalized float image would be
+    divided by 255 AGAIN in _finish_image and train silently mis-scaled
+    (ADVICE r5)."""
+    from ddlpc_tpu.data import load_scene_dir
+
+    rng = np.random.default_rng(3)
+    np.save(
+        tmp_path / "s_img.npy",
+        rng.uniform(0, 1, (16, 16, 3)).astype(np.float32),
+    )
+    np.save(tmp_path / "s.npy", rng.integers(0, 6, (16, 16)).astype(np.int32))
+    with pytest.raises(ValueError, match="uint8"):
+        load_scene_dir(str(tmp_path))
+    # Same dir with a uint8 scene loads (and normalizes once).
+    np.save(
+        tmp_path / "s_img.npy",
+        rng.integers(0, 255, (16, 16, 3), dtype=np.uint8),
+    )
+    scenes = load_scene_dir(str(tmp_path))
+    assert scenes[0][0].dtype == np.float32
+    assert scenes[0][0].max() <= 1.0
+
+
 def _write_tile_dir(path, n=6, hw=(16, 16), fmt="png"):
     import os
 

@@ -112,7 +112,15 @@ def test_unet_s2d_stem_shapes():
     assert logits.shape == (2, 64, 64, 6)
 
 
-@pytest.mark.parametrize("stem_factor", [2, 4])
+@pytest.mark.parametrize(
+    "stem_factor",
+    [
+        # Factor 2 is slow-only: factor 4 (kept in tier-1) is the flagship
+        # operating point and exercises the identical stem/head code path.
+        pytest.param(2, marks=pytest.mark.slow),
+        4,
+    ],
+)
 def test_unet_s2d_stem_learns(tmp_path, stem_factor):
     """The TPU-optimized stem must actually train to the same place the
     plain stem does on synthetic tiles — at BOTH factors; factor 4 is the
@@ -360,6 +368,7 @@ def test_detail_head_rejected_where_unimplemented():
         build_model(ModelConfig(name="deeplabv3p", detail_head=True))
 
 
+@pytest.mark.slow  # tier-1 keeps test_unet_detail_head_learns (same head)
 def test_unetpp_detail_head_learns(tmp_path):
     """U-Net++ shares ONE DetailHead across all supervision heads (shared
     params keep the heads consistent); it must train end to end with deep
@@ -446,6 +455,7 @@ def test_grouped_layout_loss_and_grads_identical(detail):
         )
 
 
+@pytest.mark.slow  # s2d-grid head variant; fullres head learn stays tier-1
 def test_stem_grid_detail_head_learns(tmp_path):
     """detail_head_kind='s2d' + train_head_layout='grouped' (the round-4
     fused-head candidate) must train end to end and produce full-res logits
@@ -498,6 +508,7 @@ def test_head_option_validation():
         build_model(ModelConfig(detail_head_scope="sometimes"))
 
 
+@pytest.mark.slow  # scope wiring asserted cheaply elsewhere; learn is slow
 def test_unetpp_ensemble_scope_shapes_and_learns(tmp_path):
     """detail_head_scope='ensemble': supervision heads train unrefined plus
     ONE refined ensemble output (stacked last); inference returns the

@@ -54,6 +54,9 @@ def trained_workdir(tmp_path):
     workdir = str(tmp_path / "run")
     trainer = Trainer(tiny_config(workdir), resume=False)
     trainer.save(epoch=3)
+    # save() is asynchronous by default (checkpoint_async): barrier before
+    # the tests read the directory, as fit() does on exit.
+    trainer.checkpointer.wait()
     return workdir, trainer
 
 

@@ -11,6 +11,12 @@ Full-scale evidence (512², 40 epochs, real chip): scripts/convergence_ab.py
 
 import pytest
 
+# Convergence-quality A/Bs: the module fixture trains three full runs
+# (~6 min of the tier-1 870 s budget on the CPU harness).  Codec
+# CORRECTNESS stays in tier-1 (test_quantize, test_stochastic_rounding,
+# test_train_step quantized arms); the quality claims run full-suite.
+pytestmark = pytest.mark.slow
+
 from ddlpc_tpu.config import (
     CompressionConfig,
     DataConfig,

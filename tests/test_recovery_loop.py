@@ -18,6 +18,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 CHILD = """
@@ -60,6 +62,9 @@ print("RUN_DONE", flush=True)
 """
 
 
+@pytest.mark.slow  # two subprocess trainings + compiles (~2 min); the
+# pieces stay tier-1: watchdog arming (test_watchdog), resume
+# (test_trainer), crash atomicity (test_checkpoint_format)
 def test_stall_abort_restart_resume(tmp_path):
     workdir = str(tmp_path / "run")
     script = CHILD.format(repo_root=REPO_ROOT, workdir=workdir)

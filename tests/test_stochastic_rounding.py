@@ -136,6 +136,8 @@ def test_train_step_stochastic_runs_and_replicas_identical(transport):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow  # three full train-step compiles for one property; the
+# replica-identity and mean-preservation arms stay tier-1
 def test_train_step_seed_varies_rounding_noise():
     """The codec's rounding noise must depend on the experiment seed
     (ADVICE r2: a key folded from the step counter alone replays identical
