@@ -153,6 +153,15 @@ class DataConfig:
     # prefetch=2 grow the number of UPLOADED batches resident in HBM —
     # budget accordingly on memory-tight configs.
     loader_workers: int = 1
+    # Assemble super-batches with the native fused gather–cast–pack kernel
+    # (csrc/batch.cc): one multithreaded memory pass writing straight into
+    # the loader's preallocated buffer ring, instead of numpy's separate
+    # single-threaded gather copy + astype copy + per-batch allocation.
+    # Byte-identical to the numpy path (test-pinned).  When the kernel
+    # cannot be built/loaded (no g++, no prebuilt csrc/libdwbatch.so) the
+    # loader warns once and falls back to numpy — same discipline as the
+    # wire codec.  ShardedLoader only; device_cache gathers on device.
+    native_gather: bool = True
     # Upload the whole train set to HBM once and gather batches on device
     # (single-process, fixed-tile datasets that fit HBM — ISPRS scale is
     # ~0.5 GB).  Removes the per-epoch host→device re-upload, which on slow

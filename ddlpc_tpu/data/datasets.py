@@ -64,6 +64,29 @@ class TileDataset:
         """
         return self.images[indices], self.labels[indices]
 
+    def gather_into(
+        self, indices: np.ndarray, img_out: np.ndarray, lab_out: np.ndarray
+    ) -> None:
+        """Gather directly into caller-owned same-dtype buffers (the
+        loader's buffer-ring path) — one copy instead of allocate+copy.
+
+        Bounds are checked up front and ``np.take`` runs with
+        ``mode='clip'``: numpy documents ``mode='raise'`` as ALWAYS
+        buffered (a hidden super-batch-sized temporary plus a second
+        copy — exactly what this method exists to avoid)."""
+        idx = np.asarray(indices)
+        if len(idx) and (idx.min() < 0 or idx.max() >= len(self.images)):
+            raise IndexError(
+                f"gather index out of range for dataset of "
+                f"{len(self.images)} tiles"
+            )
+        np.take(self.images, idx, axis=0, mode="clip", out=img_out.reshape(
+            len(idx), *self.images.shape[1:]
+        ))
+        np.take(self.labels, idx, axis=0, mode="clip", out=lab_out.reshape(
+            len(idx), *self.labels.shape[1:]
+        ))
+
     def set_epoch(self, epoch: int) -> None:
         """Hook for epoch-dependent sampling (no-op for fixed tiles)."""
 
@@ -220,12 +243,23 @@ class CropDataset:
         return self._plan
 
     def gather(self, indices: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-        plan = self._crop_plan()
         ch, cw = self.crop_size
         n = len(indices)
         c = self.scenes[0][0].shape[-1]
         imgs = np.empty((n, ch, cw, c), np.float32)
         labs = np.empty((n, ch, cw), np.int32)
+        self.gather_into(indices, imgs, labs)
+        return imgs, labs
+
+    def gather_into(
+        self, indices: np.ndarray, img_out: np.ndarray, lab_out: np.ndarray
+    ) -> None:
+        """Crop straight into caller-owned fp32/int32 buffers (the loader's
+        buffer-ring path); ``gather`` is this plus the allocation."""
+        plan = self._crop_plan()
+        ch, cw = self.crop_size
+        imgs = img_out.reshape(len(indices), *self.image_shape)
+        labs = lab_out.reshape(len(indices), ch, cw)
         for out, idx in enumerate(np.asarray(indices, np.int64)):
             s, y0, x0 = plan[idx]
             img, lab = self.scenes[s]
@@ -235,7 +269,6 @@ class CropDataset:
                 # load_image_file, so eager and mmap crops are bit-identical.
                 imgs[out] /= 255.0
             labs[out] = lab[y0 : y0 + ch, x0 : x0 + cw]
-        return imgs, labs
 
     @property
     def image_shape(self) -> Tuple[int, int, int]:
@@ -307,6 +340,26 @@ class DihedralAugment:
             imgs[out] = img
             labs[out] = lab
         return imgs, labs
+
+
+def gather_into(
+    ds, indices: np.ndarray, img_out: np.ndarray, lab_out: np.ndarray
+) -> None:
+    """Gather ``ds[indices]`` into caller-owned fp32/int32 buffers.
+
+    Dispatches to the dataset's own ``gather_into`` (TileDataset: one
+    ``np.take`` copy; Crop/LazyTileDataset: materialize straight into the
+    destination) and falls back to gather-then-copy for wrappers that
+    transform tiles after materialization (:class:`DihedralAugment`).  The
+    loader's buffer ring (data/loader.py) is the caller — this is what
+    makes a steady-state epoch allocation-free on the host."""
+    fn = getattr(ds, "gather_into", None)
+    if fn is not None:
+        fn(indices, img_out, lab_out)
+        return
+    imgs, labs = ds.gather(indices)
+    img_out.reshape(imgs.shape)[...] = imgs
+    lab_out.reshape(labs.shape)[...] = labs
 
 
 def grid_tiles(
@@ -585,6 +638,17 @@ class LazyTileDataset:
         idx = np.asarray(indices, np.int64)
         imgs = np.empty((len(idx), *self._shape), np.float32)
         labs = np.empty((len(idx), *self._shape[:2]), np.int32)
+        self.gather_into(idx, imgs, labs)
+        return imgs, labs
+
+    def gather_into(
+        self, indices: np.ndarray, img_out: np.ndarray, lab_out: np.ndarray
+    ) -> None:
+        """Read tiles from disk straight into caller-owned fp32/int32
+        buffers (the loader's buffer-ring path)."""
+        idx = np.asarray(indices, np.int64)
+        imgs = img_out.reshape(len(idx), *self._shape)
+        labs = lab_out.reshape(len(idx), *self._shape[:2])
         for out, i in enumerate(idx):
             img, lab = _read_tile(
                 *self.pairs[i], self.image_size, self.normalize, self.channels
@@ -596,7 +660,6 @@ class LazyTileDataset:
                 )
             imgs[out] = img
             labs[out] = lab
-        return imgs, labs
 
     def set_epoch(self, epoch: int) -> None:
         """Fixed tiles: nothing epoch-dependent."""

@@ -18,8 +18,11 @@ sharded over the mesh ``data`` axis (and H over ``space`` when used).
 
 from __future__ import annotations
 
+import threading
+import warnings
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
+from contextlib import nullcontext
 from typing import Iterator, Optional, Tuple
 
 import jax
@@ -28,7 +31,8 @@ import ml_dtypes
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ddlpc_tpu.data.datasets import TileDataset
+from ddlpc_tpu.data.datasets import TileDataset, gather_into as _gather_into
+from ddlpc_tpu.utils import native as _native
 
 
 def _compact_cast(
@@ -36,12 +40,9 @@ def _compact_cast(
 ) -> Tuple[np.ndarray, np.ndarray]:
     """fp32/int32 → bf16/int8 (44% of the bytes), shared by BOTH transports
     so the wire form and the resident-cache form can never drift.  Labels
-    must fit int8 with the −1 void sentinel."""
-    if labs.min() < -1 or labs.max() > 127:
-        raise ValueError(
-            f"compact=True needs labels in [-1, 127] for int8, "
-            f"got range [{labs.min()}, {labs.max()}]"
-        )
+    must fit int8 with the −1 void sentinel (contract owned by
+    utils/native.py so the numpy and kernel paths cannot diverge)."""
+    _native.check_label_range(labs.min(), labs.max())
     return imgs.astype(ml_dtypes.bfloat16), labs.astype(np.int8)
 
 
@@ -52,6 +53,99 @@ def make_global_array(
     return jax.make_array_from_process_local_data(
         NamedSharding(mesh, spec), local
     )
+
+
+_warned_native_fallback = False
+
+
+def _warn_native_fallback() -> None:
+    """One warning per process when native_gather is requested but the
+    kernel is unavailable — the same silent-degradation discipline wire.py
+    avoids: the run keeps working on the byte-identical numpy path, but the
+    operator can see WHY the host input rate is 1-core-bound."""
+    global _warned_native_fallback
+    if not _warned_native_fallback:
+        _warned_native_fallback = True
+        warnings.warn(
+            "native batch kernel unavailable (csrc/libdwbatch.so missing and "
+            "not buildable — is g++ installed?); ShardedLoader falls back to "
+            "the single-threaded numpy gather path (byte-identical, slower). "
+            "Run `make -C csrc batch` to build it, or set "
+            "DataConfig.native_gather=false to silence this.",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+
+
+def _aliases_host_storage(arrays, spans) -> bool:
+    """Whether any device shard of ``arrays`` zero-copy aliases one of the
+    host buffer ``spans`` ([start, end) address ranges).
+
+    Some backends' host→device transfer (notably CPU clients) may alias a
+    suitably-aligned numpy buffer instead of copying, and whether a given
+    buffer qualifies depends on its alignment and transfer path — so this
+    is checked per upload against the ACTUAL uploaded arrays, not probed
+    once with a stand-in.  Decides the ring's recycling policy: real
+    copies (TPU HBM) → the slot is reusable once the transfer completes;
+    aliased → the slot's storage is handed to the array and the ring
+    refills with a fresh allocation (the pre-ring behavior — correctness
+    first).  Unverifiable shards count as aliased."""
+    for ga in arrays:
+        for shard in ga.addressable_shards:
+            try:
+                p = shard.data.unsafe_buffer_pointer()
+            except Exception:
+                return True
+            if any(lo <= p < hi for lo, hi in spans):
+                return True
+    return False
+
+
+class _Slot:
+    """One ring entry: the final [A, B_local, ...] destination pair plus
+    (only when the compact cast cannot fuse with the gather) fp32/int32
+    scratch for the gather stage."""
+
+    __slots__ = ("imgs", "labs", "scratch_imgs", "scratch_labs")
+
+    def __init__(self, imgs, labs, scratch_imgs=None, scratch_labs=None):
+        self.imgs = imgs
+        self.labs = labs
+        self.scratch_imgs = scratch_imgs
+        self.scratch_labs = scratch_labs
+
+
+class _HostRing:
+    """Fixed pool of preallocated super-batch destination buffers.
+
+    ``acquire`` blocks until a slot is free; ``release`` returns it —
+    or, with ``retire=True``, hands the slot's DESTINATION storage to
+    whoever aliased it (an uploaded device array) and refills the pool
+    with a fresh allocation, so the pool size is invariant either way.
+    The replacement is allocated outside the lock (it can be hundreds of
+    MB — other producers must not serialize behind it) and keeps the old
+    slot's scratch buffers, which are never uploaded and so never
+    aliased."""
+
+    def __init__(self, nslots: int, alloc):
+        # alloc(reuse_scratch_from=None) builds a slot, optionally
+        # adopting an existing slot's scratch pair.
+        self._alloc = alloc
+        self._cv = threading.Condition()
+        self._slots = [alloc() for _ in range(nslots)]
+
+    def acquire(self) -> _Slot:
+        with self._cv:
+            while not self._slots:
+                self._cv.wait()
+            return self._slots.pop()
+
+    def release(self, slot: _Slot, retire: bool = False) -> None:
+        if retire:
+            slot = self._alloc(reuse_scratch_from=slot)
+        with self._cv:
+            self._slots.append(slot)
+            self._cv.notify()
 
 
 class _EpochSampler:
@@ -98,6 +192,15 @@ class ShardedLoader(_EpochSampler):
     epoch permutation (seeded), takes its contiguous per-process slice, and
     uploads only that slice.
 
+    Host assembly runs through a ring of ``max(prefetch, workers) + 1``
+    preallocated destination buffers and, by default, the native fused
+    gather–cast–pack kernel (csrc/batch.cc, ``native_gather``): one
+    multithreaded memory pass per super-batch instead of numpy's
+    single-threaded gather copy + astype copy + per-batch allocation.
+    Byte-identical to the numpy fallback (tests/test_native_batch.py);
+    per-stage host timings flow into ``timer`` when one is supplied
+    (docs/PERF.md "Host-upload path isolated").
+
     ``tail='wrap'`` (default) pads the epoch to a whole number of
     super-batches by wrapping the permutation, so every tile is seen at
     least once per epoch regardless of batch arithmetic — the reference
@@ -121,6 +224,8 @@ class ShardedLoader(_EpochSampler):
         tail: str = "wrap",
         compact: bool = False,
         workers: int = 1,
+        native_gather: bool = True,
+        timer=None,
     ):
         self.ds = dataset
         self.mesh = mesh
@@ -147,6 +252,23 @@ class ShardedLoader(_EpochSampler):
         if workers < 1:
             raise ValueError(f"workers must be >= 1, got {workers}")
         self.workers = workers
+        # Native fused gather–cast–pack (csrc/batch.cc): one multithreaded
+        # memory pass instead of numpy's separate gather copy + astype copy,
+        # writing straight into the ring's packed destination buffer.  When
+        # the kernel is unavailable (no g++, no prebuilt .so) the loader
+        # logs once and runs the byte-identical numpy path — same fallback
+        # discipline as the wire codec (utils/wire.py).
+        self.native_gather = native_gather
+        self._native = _native.load_batch() if native_gather else None
+        if native_gather and self._native is None:
+            _warn_native_fallback()
+        # Optional StageTimer: per-stage host timings (loader_gather /
+        # loader_cast / loader_upload) surface in the trainer's metrics
+        # JSONL next to t_data/t_step.  Must be thread-safe (StageTimer
+        # is) — stages run on producer threads.
+        self.timer = timer
+        self._ring: Optional[_HostRing] = None
+        self._iota_cache: Optional[np.ndarray] = None
         self._epoch = 0
 
         nproc = jax.process_count()
@@ -187,23 +309,143 @@ class ShardedLoader(_EpochSampler):
             chunk = idx[start : start + self.super_batch].reshape(A, Bg)
             yield chunk[:, pid * Bl : (pid + 1) * Bl].reshape(-1)
 
-    def _produce_host(self, flat: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-        """flat indices → host-side [A, B_local, ...] arrays (gather, the
-        optional compact cast, reshape) — everything except the upload."""
-        A, Bl = self.sync_period, self.local_micro_batch
-        imgs, labs = self.ds.gather(flat)
-        if self.compact:
-            # Cast on the host (worker thread — overlaps consumer compute)
-            # so the upload moves 44% of the fp32 bytes.
-            imgs, labs = _compact_cast(imgs, labs)
+    # ---- host-side assembly: buffer ring + fused native kernel ---------
+
+    def _stage(self, name: str):
         return (
-            imgs.reshape(A, Bl, *imgs.shape[1:]),
-            labs.reshape(A, Bl, *labs.shape[1:]),
+            self.timer.stage(f"loader_{name}")
+            if self.timer is not None
+            else nullcontext()
         )
 
+    def _native_source(self) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """The dataset's resident (fp32, int32) arrays when the fused
+        kernel can gather from them directly; None for lazy/crop/augment
+        sources (those materialize per gather — the kernel still fuses
+        their compact cast+pack through the scratch stage)."""
+        imgs = getattr(self.ds, "images", None)
+        labs = getattr(self.ds, "labels", None)
+        if (
+            isinstance(imgs, np.ndarray)
+            and isinstance(labs, np.ndarray)
+            and imgs.dtype == np.float32
+            and labs.dtype == np.int32
+            and imgs.flags.c_contiguous
+            and labs.flags.c_contiguous
+        ):
+            return imgs, labs
+        return None
+
+    def _get_ring(self) -> _HostRing:
+        """The destination-buffer ring, sized to the in-flight depth + the
+        one batch the consumer holds, so steady-state epochs allocate
+        nothing on the host (buffers are reused, not reallocated)."""
+        if self._ring is None:
+            A, Bl = self.sync_period, self.local_micro_batch
+            h, w, c = self.ds.image_shape
+            img_dt = ml_dtypes.bfloat16 if self.compact else np.float32
+            lab_dt = np.int8 if self.compact else np.int32
+
+            # Scratch (fp32/int32 staging for a compact cast that cannot
+            # fuse into the gather) is allocated lazily per slot on first
+            # need (_ensure_scratch) and retained, rather than decided
+            # here: whether it is needed depends on the dataset, which a
+            # caller may swap after the ring exists (the instrumentation-
+            # wrapper pattern in scripts/multiproc_trainer.py).
+            def alloc(reuse_scratch_from: Optional[_Slot] = None) -> _Slot:
+                old = reuse_scratch_from
+                return _Slot(
+                    np.empty((A, Bl, h, w, c), img_dt),
+                    np.empty((A, Bl, h, w), lab_dt),
+                    old.scratch_imgs if old is not None else None,
+                    old.scratch_labs if old is not None else None,
+                )
+
+            self._ring = _HostRing(max(self.prefetch, self.workers) + 1, alloc)
+        return self._ring
+
+    def _iota(self, n: int) -> np.ndarray:
+        if self._iota_cache is None or len(self._iota_cache) != n:
+            self._iota_cache = np.arange(n, dtype=np.int64)
+        return self._iota_cache
+
+    def _ensure_scratch(self, slot: _Slot) -> None:
+        if slot.scratch_imgs is None:
+            h, w, c = self.ds.image_shape
+            T = self.sync_period * self.local_micro_batch
+            slot.scratch_imgs = np.empty((T, h, w, c), np.float32)
+            slot.scratch_labs = np.empty((T, h, w), np.int32)
+
+    def _assemble(
+        self, flat: np.ndarray, slot: _Slot
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """flat indices → the slot's packed [A, B_local, ...] pair.
+
+        Three routes, all byte-identical (test-pinned):
+        - resident source + native kernel: ONE fused gather(+cast)+pack
+          memory pass, multithreaded (the tentpole fast path);
+        - compact without that fusion: gather fp32/int32 into the slot's
+          scratch, then one cast+pack pass (native when available, else
+          numpy copyto after the [-1, 127] label check);
+        - plain fp32: gather directly into the destination buffer.
+        There is no separate pack pass anywhere: the ring slot IS the
+        [A, B_local, H, W, C] layout, so packing is where bytes land.
+        """
+        flat = np.ascontiguousarray(flat, np.int64)
+        imgs, labs = slot.imgs, slot.labs
+        src = self._native_source() if self._native is not None else None
+        if src is not None:
+            with self._stage("gather"):
+                self._native.gather_pack(
+                    src[0], src[1], flat, imgs, labs, self.compact
+                )
+        elif self.compact:
+            self._ensure_scratch(slot)
+            with self._stage("gather"):
+                _gather_into(self.ds, flat, slot.scratch_imgs, slot.scratch_labs)
+            with self._stage("cast"):
+                if self._native is not None:
+                    self._native.gather_pack(
+                        slot.scratch_imgs,
+                        slot.scratch_labs,
+                        self._iota(len(flat)),
+                        imgs,
+                        labs,
+                        True,
+                    )
+                else:
+                    _native.check_label_range(
+                        slot.scratch_labs.min(), slot.scratch_labs.max()
+                    )
+                    np.copyto(
+                        imgs.reshape(slot.scratch_imgs.shape),
+                        slot.scratch_imgs,
+                        casting="unsafe",
+                    )
+                    np.copyto(
+                        labs.reshape(slot.scratch_labs.shape),
+                        slot.scratch_labs,
+                        casting="unsafe",
+                    )
+        else:
+            with self._stage("gather"):
+                _gather_into(self.ds, flat, imgs, labs)
+        return imgs, labs
+
     def _local_batches(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Yield host-side [A, B_local, ...] pairs, one per super-batch.
+
+        The yielded arrays are the loader's ring buffers and stay valid
+        only until the next iteration step (the slot is recycled when the
+        generator resumes) — consumers that retain a batch must copy.
+        ``__iter__`` has no such caveat: it yields device arrays whose
+        backing transfer completed (or owns the storage outright)."""
         for flat in self._super_batch_index_chunks():
-            yield self._produce_host(flat)
+            slot = self._get_ring().acquire()
+            try:
+                yield self._assemble(flat, slot)
+            finally:
+                self._get_ring().release(slot)
 
     def _upload(self, item: Tuple[np.ndarray, np.ndarray]):
         imgs, labs = item
@@ -213,7 +455,30 @@ class ShardedLoader(_EpochSampler):
         )
 
     def _produce(self, flat: np.ndarray):
-        return self._upload(self._produce_host(flat))
+        ring = self._get_ring()
+        slot = ring.acquire()
+        retire = False
+        try:
+            host = self._assemble(flat, slot)
+            with self._stage("upload"):
+                out = self._upload(host)
+                spans = [
+                    (a.ctypes.data, a.ctypes.data + a.nbytes)
+                    for a in (slot.imgs, slot.labs)
+                ]
+                if _aliases_host_storage(out, spans):
+                    # The "device" arrays share the slot's storage (CPU
+                    # zero-copy): hand it over, refill with a fresh slot
+                    # — the pre-ring allocation rate, never a stale batch.
+                    retire = True
+                else:
+                    # Real copies (TPU HBM): once the transfer lands the
+                    # slot is reusable — zero host allocation per batch.
+                    for a in out:
+                        a.block_until_ready()
+            return out
+        finally:
+            ring.release(slot, retire=retire)
 
     def __iter__(self) -> Iterator[Tuple[jax.Array, jax.Array]]:
         """Yield device-resident super-batches in epoch order, with the
@@ -237,6 +502,10 @@ class ShardedLoader(_EpochSampler):
             for flat in self._super_batch_index_chunks():
                 yield self._produce(flat)
             return
+        # Materialize the ring on the consumer thread before the pool
+        # starts: it is lazily built and concurrent first-touch from
+        # workers would race the construction.
+        self._get_ring()
         # In-flight depth must cover the worker count or extra workers sit
         # idle forever (one submit per consumed batch): workers=N implies
         # at least N batches in flight, at the corresponding memory cost.

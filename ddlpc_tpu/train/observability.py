@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from contextlib import contextmanager
 from typing import Dict, Optional
@@ -97,11 +98,16 @@ class MetricsLogger:
 class StageTimer:
     """Named wall-clock stage timing — the structured form of the
     reference's scattered ``time.time()`` delta prints (кластер.py:265-440).
-    Accumulates totals; ``summary()`` gives seconds per stage."""
+    Accumulates totals; ``summary()`` gives seconds per stage.
+
+    Thread-safe: the ShardedLoader's producer pool records its
+    loader_gather/cast/upload stages from worker threads concurrently with
+    the training thread's data/step stages."""
 
     def __init__(self):
         self.totals: Dict[str, float] = {}
         self.counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
 
     @contextmanager
     def stage(self, name: str):
@@ -110,18 +116,24 @@ class StageTimer:
             yield
         finally:
             dt = time.perf_counter() - t0
-            self.totals[name] = self.totals.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + 1
+            with self._lock:
+                self.totals[name] = self.totals.get(name, 0.0) + dt
+                self.counts[name] = self.counts.get(name, 0) + 1
 
     def summary(self) -> Dict[str, float]:
-        return dict(self.totals)
+        with self._lock:
+            return dict(self.totals)
 
     def means(self) -> Dict[str, float]:
-        return {k: self.totals[k] / max(self.counts[k], 1) for k in self.totals}
+        with self._lock:
+            return {
+                k: self.totals[k] / max(self.counts[k], 1) for k in self.totals
+            }
 
     def reset(self) -> None:
-        self.totals.clear()
-        self.counts.clear()
+        with self._lock:
+            self.totals.clear()
+            self.counts.clear()
 
 
 @contextmanager

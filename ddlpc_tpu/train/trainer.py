@@ -127,6 +127,11 @@ class Trainer:
         self.spatial = cfg.parallel.space_axis_size > 1
         space = cfg.parallel.space_axis_name if self.spatial else None
 
+        # Created before the loader so the ShardedLoader can thread its
+        # per-stage host timings (loader_gather/cast/upload) into the same
+        # epoch records as t_data/t_step (StageTimer is thread-safe; the
+        # stages run on producer threads).
+        self.timer = StageTimer()
         loader_cls = (
             DeviceCachedLoader if cfg.data.device_cache else ShardedLoader
         )
@@ -136,7 +141,9 @@ class Trainer:
         loader_kw = (
             {"compact": cfg.data.compact_upload} if cfg.data.device_cache
             else {"compact": cfg.data.compact_upload,
-                  "workers": cfg.data.loader_workers}
+                  "workers": cfg.data.loader_workers,
+                  "native_gather": cfg.data.native_gather,
+                  "timer": self.timer}
         )
         self.loader = loader_cls(
             self.train_ds,
@@ -192,7 +199,6 @@ class Trainer:
         if resume:
             self._restore_synchronized()
         self.logger = MetricsLogger(self.workdir, run_config_json=cfg.to_json())
-        self.timer = StageTimer()
         # Failure detection (SURVEY §5: the reference has none and hangs
         # forever on a dead peer).  Armed by fit(); beats come from the
         # epoch loop's data/step stages.
@@ -346,9 +352,17 @@ class Trainer:
         (it splits a test set and never touches it, SURVEY §3.3)."""
         if len(self.test_ds) == 0:
             return {}
-        cm = np.zeros((self.cfg.model.num_classes,) * 2, np.float64)
-        loss_sum = 0.0
-        pixels = 0.0
+        # Keep the per-batch sums ON DEVICE and fetch once per evaluation:
+        # the old per-batch `cm += np.asarray(...)` forced one host round
+        # trip per eval batch (~114 ms each on a tunneled/remote link,
+        # docs/PERF.md).  Same pattern as train_epoch's loss list: collect
+        # the device arrays, one batched device_get at the end, then the
+        # exact float64 accumulation happens on the host — per-batch fp32
+        # confusion entries are exact (a batch holds < 2^24 pixels), and
+        # no device dtype has to survive a whole evaluation's total (a
+        # running uint32 would wrap past 2^32 pixels on Cityscapes-scale
+        # splits; float64 is unavailable without jax x64).
+        per_batch = []
         for images, labels in eval_batches(
             self.test_ds,
             self.mesh,
@@ -358,9 +372,22 @@ class Trainer:
         ):
             self.watchdog.beat("eval")
             out = self.eval_step(self.state, images, labels)
-            cm += np.asarray(out["confusion"], np.float64)
-            loss_sum += float(out["loss_sum"])
-            pixels += float(out["pixel_count"])
+            per_batch.append(
+                (out["confusion"], out["loss_sum"], out["pixel_count"])
+            )
+        # The batched fetch waits for the WHOLE evaluation's queued device
+        # compute (dispatches above are async), which can dwarf the
+        # step-sized stall timeout — suspend detection rather than mis-size
+        # it, exactly like the checkpoint/image-dump paths.
+        with self.watchdog.paused("eval_metrics_fetch"):
+            per_batch = jax.device_get(per_batch)
+        cm = np.zeros((self.cfg.model.num_classes,) * 2, np.float64)
+        loss_sum = 0.0
+        pixels = 0.0
+        for conf, nll, px in per_batch:
+            cm += np.asarray(conf, np.float64)
+            loss_sum += float(nll)
+            pixels += float(px)
         return {
             "val_loss": loss_sum / max(pixels, 1.0),
             "val_pixel_acc": float(accuracy_from_confusion(cm)),

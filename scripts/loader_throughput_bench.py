@@ -7,10 +7,19 @@ HBM) had no throughput claim that isn't dominated by this environment's
 tunneled device link.  This bench isolates the loader:
 
 - `gather` arm: `_local_batches()` alone — the host-side index/gather/
-  reshape rate with NO device involvement (the absolute host ceiling).
+  cast/pack rate with NO device involvement (the absolute host ceiling).
 - `upload` arm: the full `__iter__` path (gather + `make_global_array` +
   prefetch overlap) with a per-super-batch scalar fetch as the consumer —
   the realistic cadence (a train step consumes each batch and forces it).
+
+`--native {auto,on,off}` selects the assembly engine: `on`/`auto` use the
+fused gather–cast–pack kernel (csrc/batch.cc) writing into the loader's
+buffer ring; `off` forces the single-threaded numpy path (the pre-native
+baseline).  `on` errors when the kernel is unavailable so a CI arm cannot
+silently measure the wrong engine; `auto` takes the loader's logged
+fallback.  Per-stage means (`loader_gather`/`loader_cast`/
+`loader_upload`, via StageTimer) land in the record so a regression is
+attributable to gather vs cast vs upload rather than re-isolated by hand.
 
 On `--backend cpu` the device "upload" is a host memcpy, so the upload arm
 measures the path at memory-bandwidth realism — the non-tunnel-bound
@@ -19,10 +28,14 @@ same arm documents the tunnel floor next to it.  BASELINE context: the
 reference feeds ≥400 tiles/s/chip equivalents through a blocking host copy
 (кластер.py:754); the prefetch design must beat that on a real host link.
 
-Writes/merges docs/disk_fit/loader_throughput.json (key: backend+shape).
+Writes/merges docs/disk_fit/loader_throughput.json (key: backend+shape)
+and prints the driver-contract line
+  {"metric": "loader_tiles_per_s", "value": <gather-arm tiles/s>, ...}
+as the LAST stdout line.
 
 Usage: python scripts/loader_throughput_bench.py --backend cpu
-       [--tiles 256] [--micro-batch 32] [--sync 4] [--epochs 3]
+       [--native auto] [--tiles 256] [--micro-batch 32] [--sync 4]
+       [--epochs 3] [--workers N] [--compact] [--source memory]
 """
 
 from __future__ import annotations
@@ -53,8 +66,13 @@ def main() -> None:
                         "bf16-compute models)")
     p.add_argument("--workers", type=int, default=1,
                    help="producer threads (ShardedLoader(workers=...)); "
-                        "scales with cores on a pod host, not on this "
-                        "1-core machine")
+                        "the native kernel additionally multithreads "
+                        "INSIDE each batch")
+    p.add_argument("--native", default="auto", choices=["auto", "on", "off"],
+                   help="fused native gather-cast-pack (csrc/batch.cc): "
+                        "on = require it (error if unavailable), off = "
+                        "force the numpy path, auto = native with logged "
+                        "fallback")
     p.add_argument("--source", default="memory",
                    choices=["memory", "lazy-npy", "lazy-png"],
                    help="memory: resident SyntheticTiles; lazy-*: a "
@@ -75,6 +93,14 @@ def main() -> None:
     from ddlpc_tpu.data.datasets import SyntheticTiles, load_tile_dir
     from ddlpc_tpu.data.loader import ShardedLoader
     from ddlpc_tpu.parallel.mesh import make_mesh
+    from ddlpc_tpu.train.observability import StageTimer
+    from ddlpc_tpu.utils import native
+
+    if args.native == "on" and native.load_batch() is None:
+        raise SystemExit(
+            "--native on: csrc/libdwbatch.so unavailable and not buildable "
+            "(is g++ installed?); use --native auto for logged fallback"
+        )
 
     ds = SyntheticTiles(
         num_tiles=args.tiles, image_size=(args.size, args.size)
@@ -102,9 +128,18 @@ def main() -> None:
             )
         ds = load_tile_dir(tmp_ctx.name, lazy=True)
     mesh = make_mesh(ParallelConfig())
+    timer = StageTimer()
     loader = ShardedLoader(
         ds, mesh, global_micro_batch=args.micro_batch,
         sync_period=args.sync, compact=args.compact, workers=args.workers,
+        native_gather=args.native != "off", timer=timer,
+    )
+    # "native" must record that the kernel is actually ON THE MEASURED
+    # PATH, not merely loaded: non-compact lazy sources never invoke it
+    # (per-tile disk reads can't fuse and there is no cast stage), so such
+    # a run is the numpy path and must not carry a _native key/label.
+    native_used = loader._native is not None and (
+        loader._native_source() is not None or args.compact
     )
     bytes_per_tile = args.size * args.size * (
         (3 * 2 + 1) if args.compact else (3 * 4 + 4)
@@ -117,13 +152,25 @@ def main() -> None:
         "epochs": args.epochs,
         "compact": args.compact,
         "workers": args.workers,
+        "native": native_used,
+        "host_cores": os.cpu_count(),
         "source": args.source,
         "mb_per_tile": round(bytes_per_tile / 2**20, 3),
     }
 
+    def stage_means() -> dict:
+        # Per-batch stage means in ms — the attribution column: a future
+        # regression shows up as gather vs cast vs upload, not as one
+        # opaque tiles/s drop.
+        return {
+            k.replace("loader_", ""): round(v * 1e3, 1)
+            for k, v in sorted(timer.means().items())
+        }
+
     # -- gather arm: host-side ceiling, no device involvement.
     loader.set_epoch(0)
     next(iter(loader._local_batches()))  # warm caches
+    timer.reset()
     t0 = time.perf_counter()
     n = 0
     for ep in range(args.epochs):
@@ -133,6 +180,7 @@ def main() -> None:
     dt = time.perf_counter() - t0
     rec["gather_tiles_per_s"] = round(n / dt, 1)
     rec["gather_gb_per_s"] = round(n * bytes_per_tile / dt / 2**30, 2)
+    rec["gather_stage_ms"] = stage_means()
 
     # -- upload arm: full iter path, per-super-batch scalar fetch (the
     # train-step consumer cadence; on a tunneled device every fetch is a
@@ -141,6 +189,7 @@ def main() -> None:
     for imgs, labs in loader:  # warm epoch: compile/layout/alloc paths
         float(imgs.ravel()[0])
         break
+    timer.reset()
     t0 = time.perf_counter()
     n = 0
     for ep in range(args.epochs):
@@ -152,12 +201,13 @@ def main() -> None:
     rec["upload_tiles_per_s"] = round(n / dt, 1)
     rec["upload_gb_per_s"] = round(n * bytes_per_tile / dt / 2**30, 2)
     rec["upload_vs_baseline_400"] = round(rec["upload_tiles_per_s"] / 400, 2)
+    rec["upload_stage_ms"] = stage_means()
 
     key = f"{rec['backend']}_{args.size}px_b{args.micro_batch}x{args.sync}" + (
         "_compact" if args.compact else ""
     ) + ("" if args.source == "memory" else f"_{args.source}") + (
         "" if args.workers == 1 else f"_w{args.workers}"
-    )
+    ) + ("_native" if native_used else "")
     if tmp_ctx is not None:
         tmp_ctx.cleanup()
     merged = {}
@@ -168,6 +218,16 @@ def main() -> None:
     with open(args.out, "w") as f:
         json.dump(merged, f, indent=2)
     print(json.dumps({key: rec}))
+    # Driver contract (same shape as bench.py / serve_bench.py): exactly
+    # one {"metric": ...} line, last on stdout.  The gather arm is the
+    # host-path headline — device-independent, the number the ≥2×-numpy
+    # acceptance gate reads.
+    print(json.dumps({
+        "metric": "loader_tiles_per_s",
+        "value": rec["gather_tiles_per_s"],
+        "unit": "tiles/s",
+        "vs_baseline": round(rec["gather_tiles_per_s"] / 400, 2),
+    }))
 
 
 if __name__ == "__main__":

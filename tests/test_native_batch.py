@@ -1,0 +1,281 @@
+"""Native fused gather–cast–pack (csrc/batch.cc) + the loader buffer ring.
+
+The contract under test: the native kernel is a pure speedup — every
+observable (bytes, order, errors) is identical to the numpy path, the ring
+recycles buffers without ever overwriting a batch a consumer still holds,
+and a missing toolchain degrades to numpy loudly (one warning), never
+silently forever (the build-or-skip canary below fails when g++ exists but
+the kernel won't build)."""
+
+import os
+import shutil
+
+import ml_dtypes
+import numpy as np
+import pytest
+
+from ddlpc_tpu.config import ParallelConfig
+from ddlpc_tpu.data import ShardedLoader, SyntheticTiles, TileDataset
+from ddlpc_tpu.data.datasets import DihedralAugment, load_tile_dir
+from ddlpc_tpu.parallel.mesh import make_mesh
+from ddlpc_tpu.utils import native
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(ParallelConfig(data_axis_size=-1, space_axis_size=1))
+
+
+@pytest.fixture(scope="module")
+def kernel():
+    nb = native.load_batch()
+    if nb is None:
+        pytest.skip("native batch kernel unavailable (no toolchain)")
+    return nb
+
+
+def test_native_batch_builds_or_skips():
+    """Tier-1 toolchain canary: with a compiler present the kernel MUST
+    build and load — a csrc/ regression fails here instead of silently
+    falling back to numpy forever.  Without any toolchain (and no prebuilt
+    .so) the skip records the environment honestly."""
+    lib = native.load_batch()
+    if lib is not None:
+        return
+    if shutil.which("g++") is None and not os.path.exists(native._BATCH_LIB):
+        pytest.skip("no g++ and no prebuilt libdwbatch.so")
+    pytest.fail(
+        "g++ (or a prebuilt libdwbatch.so) is present but the native batch "
+        "kernel failed to build/load — toolchain regression, not an "
+        "acceptable fallback"
+    )
+
+
+def test_kernel_bf16_cast_parity_with_ml_dtypes(kernel):
+    """The fused cast must be bit-equal to astype(ml_dtypes.bfloat16) —
+    round-to-nearest-even INCLUDING specials (NaN quieting, infs, signed
+    zero, denormals) — because the numpy fallback uses astype and the two
+    paths must be interchangeable mid-run."""
+    rng = np.random.default_rng(0)
+    imgs = (
+        rng.standard_normal((20, 7, 5, 3))
+        * 10.0 ** rng.integers(-30, 30, (20, 7, 5, 3)).astype(np.float64)
+    ).astype(np.float32)
+    imgs.reshape(-1)[:8] = [
+        np.nan, np.inf, -np.inf, 0.0, -0.0, 1e-40, -1e-40, 3.14159,
+    ]
+    labs = rng.integers(-1, 128, (20, 7, 5)).astype(np.int32)
+    idx = rng.integers(0, 20, 13).astype(np.int64)
+    img_out = np.empty((13, 7, 5, 3), ml_dtypes.bfloat16)
+    lab_out = np.empty((13, 7, 5), np.int8)
+    kernel.gather_pack(imgs, labs, idx, img_out, lab_out, compact=True)
+    ref = imgs[idx].astype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(
+        img_out.view(np.uint16), ref.view(np.uint16)
+    )
+    np.testing.assert_array_equal(lab_out, labs[idx].astype(np.int8))
+
+    # fp32 path: byte-exact gather at packed offsets, repeats included.
+    img32 = np.empty((13, 7, 5, 3), np.float32)
+    lab32 = np.empty((13, 7, 5), np.int32)
+    kernel.gather_pack(imgs, labs, idx, img32, lab32, compact=False)
+    assert img32.tobytes() == imgs[idx].tobytes()
+    np.testing.assert_array_equal(lab32, labs[idx])
+
+
+def test_kernel_error_codes(kernel):
+    imgs = np.zeros((4, 2, 2, 3), np.float32)
+    labs = np.zeros((4, 2, 2), np.int32)
+    io = np.empty((1, 2, 2, 3), np.float32)
+    lo = np.empty((1, 2, 2), np.int32)
+    with pytest.raises(IndexError, match="out of range"):
+        kernel.gather_pack(imgs, labs, np.array([9], np.int64), io, lo, False)
+    wide = labs.copy()
+    wide[0] = 200
+    ib = np.empty((1, 2, 2, 3), ml_dtypes.bfloat16)
+    lb = np.empty((1, 2, 2), np.int8)
+    with pytest.raises(ValueError, match=r"\[-1, 127\].*\[200, 200\]"):
+        kernel.gather_pack(
+            imgs, wide, np.array([0], np.int64), ib, lb, True
+        )
+
+
+def _epochs(ds, mesh, *, epochs=2, **kw):
+    loader = ShardedLoader(
+        ds, mesh, global_micro_batch=8, sync_period=2, seed=4, **kw
+    )
+    out = []
+    for epoch in range(epochs):
+        loader.set_epoch(epoch)
+        for imgs, labs in loader:
+            out.append((np.asarray(imgs).copy(), np.asarray(labs).copy()))
+    return out
+
+
+@pytest.mark.parametrize("compact", [False, True])
+@pytest.mark.parametrize("workers", [1, 3])
+def test_native_byte_identical_to_numpy(mesh, kernel, compact, workers):
+    """The kernel arm must serve byte-identical epochs to the numpy arm
+    across compact on/off (fp32/bf16 images, int8 labels with the -1 void
+    sentinel in range) and worker counts — including the wrap-fill tail
+    (13 tiles against super-batch 16 repeats tiles within one batch)."""
+    ds = SyntheticTiles(num_tiles=13, image_size=(8, 8), seed=9)
+    ds.labels[0, 0, 0] = -1  # void sentinel must survive the int8 cast
+    ref = _epochs(ds, mesh, native_gather=False, prefetch=0, compact=compact)
+    arm = _epochs(
+        ds, mesh, native_gather=True, workers=workers, compact=compact
+    )
+    assert len(ref) == len(arm) == 2  # ceil(13/16) = 1 per epoch
+    for (ri, rl), (ai, al) in zip(ref, arm):
+        assert ai.dtype == (ml_dtypes.bfloat16 if compact else np.float32)
+        assert al.dtype == (np.int8 if compact else np.int32)
+        np.testing.assert_array_equal(ri, ai)
+        np.testing.assert_array_equal(rl, al)
+
+
+def test_native_lazy_tiles_and_augment_match_numpy(tmp_path, mesh, kernel):
+    """Non-resident sources can't fuse the gather, but the compact
+    cast+pack still runs native through the scratch stage — and must stay
+    byte-identical to numpy for lazy (per-gather disk reads) and augment
+    (generic gather-then-copy fallback) sources."""
+    rng = np.random.default_rng(11)
+    for i in range(10):
+        np.save(
+            tmp_path / f"t{i:02d}_img.npy",
+            rng.integers(0, 255, (8, 8, 3), dtype=np.uint8),
+        )
+        np.save(
+            tmp_path / f"t{i:02d}.npy",
+            rng.integers(0, 6, (8, 8)).astype(np.int32),
+        )
+    lazy = load_tile_dir(str(tmp_path), lazy=True)
+    for compact in (False, True):
+        ref = _epochs(
+            lazy, mesh, native_gather=False, prefetch=0, compact=compact
+        )
+        arm = _epochs(
+            lazy, mesh, native_gather=True, workers=3, compact=compact
+        )
+        for (ri, rl), (ai, al) in zip(ref, arm):
+            np.testing.assert_array_equal(ri, ai)
+            np.testing.assert_array_equal(rl, al)
+
+    aug = DihedralAugment(
+        SyntheticTiles(num_tiles=16, image_size=(8, 8), seed=3), seed=5
+    )
+    ref = _epochs(aug, mesh, native_gather=False, prefetch=0, compact=True)
+    arm = _epochs(aug, mesh, native_gather=True, compact=True)
+    for (ri, rl), (ai, al) in zip(ref, arm):
+        np.testing.assert_array_equal(ri, ai)
+        np.testing.assert_array_equal(rl, al)
+
+
+def test_native_compact_rejects_wide_labels(mesh, kernel):
+    """The fused kernel's in-pass range check must raise the numpy path's
+    exact contract (ValueError naming [-1, 127]) — not wrap silently."""
+    wide = TileDataset(
+        np.zeros((8, 8, 8, 3), np.float32),
+        np.full((8, 8, 8), 200, np.int32),
+    )
+    loader = ShardedLoader(
+        wide, mesh, global_micro_batch=8, sync_period=1, prefetch=0,
+        compact=True, native_gather=True,
+    )
+    with pytest.raises(ValueError, match=r"\[-1, 127\]"):
+        next(iter(loader))
+
+
+def test_ring_recycles_buffers_with_correct_content(mesh):
+    """The host arm (_local_batches) must actually REUSE ring storage
+    (zero-alloc steady state) while every yielded batch matches the
+    reference at yield time — the aliasing contract is 'valid until the
+    consumer advances', and advancing is the only thing that recycles."""
+    ds = SyntheticTiles(num_tiles=40, image_size=(8, 8), seed=6)
+    loader = ShardedLoader(
+        ds, mesh, global_micro_batch=8, sync_period=2, seed=2, prefetch=2
+    )
+    seen_buffers = set()
+    batches = 0
+    for epoch in range(3):
+        loader.set_epoch(epoch)
+        flats = list(loader._super_batch_index_chunks())
+        for (imgs, labs), flat in zip(loader._local_batches(), flats):
+            ref_i, ref_l = ds.gather(flat)
+            np.testing.assert_array_equal(
+                imgs.reshape(ref_i.shape), ref_i
+            )
+            np.testing.assert_array_equal(
+                labs.reshape(ref_l.shape), ref_l
+            )
+            seen_buffers.add(imgs.ctypes.data)
+            batches += 1
+    # 9 batches through a ring of prefetch+1 = 3 slots: storage recycled.
+    assert batches == 9
+    assert len(seen_buffers) <= 3
+
+
+def test_yielded_device_batches_never_overwritten(mesh):
+    """Hold references to EVERY uploaded batch of a worker-pooled epoch and
+    verify them all at the end: if the ring recycled a slot whose storage a
+    yielded device array still aliased (CPU zero-copy backends), the early
+    batches would have been overwritten by later production."""
+    ds = SyntheticTiles(num_tiles=64, image_size=(8, 8), seed=8)
+    loader = ShardedLoader(
+        ds, mesh, global_micro_batch=8, sync_period=2, seed=1,
+        prefetch=3, workers=3,
+    )
+    held = list(loader)  # keep all 4 uploaded super-batches alive
+    flats = list(loader._super_batch_index_chunks())
+    assert len(held) == len(flats) == 4
+    for (imgs, labs), flat in zip(held, flats):
+        ref_i, ref_l = ds.gather(flat)
+        np.testing.assert_array_equal(
+            np.asarray(imgs).reshape(ref_i.shape), ref_i
+        )
+        np.testing.assert_array_equal(
+            np.asarray(labs).reshape(ref_l.shape), ref_l
+        )
+
+
+def test_forced_fallback_without_library(mesh, monkeypatch):
+    """native_gather=True with the .so unavailable must warn ONCE and serve
+    byte-identical batches through numpy — the run degrades, loudly, and
+    never breaks."""
+    from ddlpc_tpu.data import loader as loader_mod
+
+    ds = SyntheticTiles(num_tiles=16, image_size=(8, 8), seed=12)
+    ref = _epochs(ds, mesh, epochs=1, native_gather=False, prefetch=0)
+
+    monkeypatch.setattr(loader_mod._native, "load_batch", lambda **kw: None)
+    monkeypatch.setattr(loader_mod, "_warned_native_fallback", False)
+    with pytest.warns(RuntimeWarning, match="libdwbatch"):
+        loader = ShardedLoader(
+            ds, mesh, global_micro_batch=8, sync_period=2, seed=4,
+            native_gather=True,
+        )
+    assert loader._native is None
+    got = [
+        (np.asarray(i).copy(), np.asarray(l).copy()) for i, l in loader
+    ]
+    for (ri, rl), (ai, al) in zip(ref, got):
+        np.testing.assert_array_equal(ri, ai)
+        np.testing.assert_array_equal(rl, al)
+
+
+def test_loader_stage_timings_recorded(mesh):
+    """StageTimer wiring: an epoch must record loader_gather and
+    loader_upload means (cast only exists where a separate pass runs);
+    these are the rows the trainer threads into metrics JSONL."""
+    from ddlpc_tpu.train.observability import StageTimer
+
+    ds = SyntheticTiles(num_tiles=32, image_size=(8, 8), seed=7)
+    timer = StageTimer()
+    loader = ShardedLoader(
+        ds, mesh, global_micro_batch=8, sync_period=2, seed=0,
+        workers=2, timer=timer,
+    )
+    for _ in loader:
+        pass
+    means = timer.means()
+    assert "loader_gather" in means and "loader_upload" in means
+    assert all(v >= 0.0 for v in means.values())
