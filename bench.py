@@ -40,7 +40,12 @@ from ddlpc_tpu.config import (
 )
 from ddlpc_tpu.models import build_model_from_experiment
 from ddlpc_tpu.parallel.mesh import make_mesh
-from ddlpc_tpu.parallel.train_step import create_train_state, make_train_step
+from ddlpc_tpu.parallel.shard_update import StateLayout, resolve_shard_update
+from ddlpc_tpu.parallel.train_step import (
+    create_train_state,
+    make_train_step,
+    make_update_step,
+)
 from ddlpc_tpu.train.optim import build_optimizer
 
 BASELINE_TILES_PER_SEC_PER_CHIP = 400.0
@@ -208,7 +213,44 @@ BENCHES = {
 HEADLINE = "unet_vaihingen512"
 
 
-def run_bench(name: str, timed_rounds: int = TIMED_ROUNDS) -> dict:
+def measure_update_ms(
+    tx, mesh, compression, state, shard_update: bool, rounds: int = TIMED_ROUNDS
+) -> float:
+    """Time the weight-update path alone (grad sync + optimizer + — when
+    sharded — the params all-gather) via the update-only compiled program
+    (train_step.make_update_step).  ``state`` must already be in the
+    matching run layout; returns milliseconds per update."""
+    upd = make_update_step(tx, mesh, compression, shard_update=shard_update)
+    rng = np.random.default_rng(1)
+    grads = jax.tree.map(
+        lambda p: jax.device_put(
+            rng.standard_normal(p.shape).astype(np.float32) * 1e-3,
+            NamedSharding(mesh, P()),
+        ),
+        state.params,
+    )
+    # Private copies: the update program donates its params/opt_state (the
+    # realistic in-place layout), which would invalidate the caller's state.
+    clone = lambda t: jax.tree.map(
+        lambda x: jax.device_put(np.asarray(x), x.sharding), t
+    )
+    params, opt_state = clone(state.params), clone(state.opt_state)
+    for _ in range(WARMUP_STEPS):
+        params, opt_state = upd(params, opt_state, grads)
+        jax.block_until_ready(params)
+    times = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        for _ in range(PIPELINE_STEPS):
+            params, opt_state = upd(params, opt_state, grads)
+        jax.block_until_ready(params)
+        times.append((time.perf_counter() - t0) / PIPELINE_STEPS)
+    return float(np.median(times)) * 1e3
+
+
+def run_bench(
+    name: str, timed_rounds: int = TIMED_ROUNDS, shard_update: str = "auto"
+) -> dict:
     spec = BENCHES[name]
     h, w = spec["image"]
     n_devices = len(jax.devices())
@@ -218,14 +260,26 @@ def run_bench(name: str, timed_rounds: int = TIMED_ROUNDS) -> dict:
         train=TrainConfig(
             micro_batch_size=spec["micro_batch"], sync_period=spec["sync_period"]
         ),
-        parallel=ParallelConfig(),
+        parallel=ParallelConfig(shard_update=shard_update),
         compression=CompressionConfig(mode=spec["compression"]),
     )
     mesh = make_mesh(cfg.parallel)
     model = build_model_from_experiment(cfg)
     tx = build_optimizer(cfg.train)
     state = create_train_state(model, tx, jax.random.key(0), (1, h, w, 3))
-    step = make_train_step(model, tx, mesh, cfg.compression)
+    sharded = resolve_shard_update(
+        shard_update, cfg.compression, mesh.shape["data"], spatial=False
+    )
+    layout = StateLayout(
+        "zero1" if sharded else "replicated", tx, state, mesh, "data"
+    )
+    state = layout.place(state)
+    t_update_ms = measure_update_ms(
+        tx, mesh, cfg.compression, state, sharded, rounds=timed_rounds
+    )
+    step = make_train_step(
+        model, tx, mesh, cfg.compression, shard_update=sharded
+    )
 
     A = spec["sync_period"]
     global_batch = spec["micro_batch"] * n_devices
@@ -284,6 +338,10 @@ def run_bench(name: str, timed_rounds: int = TIMED_ROUNDS) -> dict:
         "timing": f"pipelined_{PIPELINE_STEPS}",
         "global_batch": global_batch,
         "sync_period": A,
+        # Weight-update path in isolation (grad sync + Adam + — sharded —
+        # the params all-gather), from the update-only compiled program.
+        "shard_update": bool(sharded),
+        "t_update_ms": round(t_update_ms, 3),
     }
 
 
@@ -366,14 +424,129 @@ print(json.dumps({'n': %(n)d, 'losses': losses, 'step_time_s': dt}))
     return out
 
 
+def run_update_ab(rounds: int, out_path: str) -> dict:
+    """Same-host A/B of the weight-update path, replicated vs ZeRO-sharded,
+    at the flagship model size: per-step ``t_update_ms`` both arms plus the
+    per-device optimizer-state bytes each layout keeps resident.  Writes
+    the committed JSON and returns the driver-contract record (the sharded
+    arm's ``update_ms_per_step``)."""
+    name = HEADLINE
+    spec = BENCHES[name]
+    h, w = spec["image"]
+    cfg = ExperimentConfig(
+        model=ModelConfig(**spec["model"]),
+        compression=CompressionConfig(mode=spec["compression"]),
+    )
+    mesh = make_mesh(cfg.parallel)
+    n_devices = mesh.shape["data"]
+    if n_devices < 2:
+        # Without this the 'on' arm silently times the replicated program
+        # (singleton fallback) and the committed artifact would claim a
+        # ZeRO measurement that never happened.
+        raise SystemExit(
+            "--update-ab needs a multi-device data mesh to measure the "
+            "sharded arm; pass --devices N (N >= 2) for a virtual CPU mesh"
+        )
+    model = build_model_from_experiment(cfg)
+    tx = build_optimizer(cfg.train)
+    # Param shapes (all the update path sees) are resolution-independent:
+    # init at the smallest tile the s2d stem + pyramid accepts, not 512².
+    state0 = create_train_state(
+        model, tx, jax.random.key(0), (1, max(h // 4, 128), max(w // 4, 128), 3)
+    )
+    arms = {}
+    for arm, sharded in (("off", False), ("on", True)):
+        layout = StateLayout(
+            "zero1" if sharded else "replicated", tx, state0, mesh, "data"
+        )
+        state = layout.place(state0)
+        opt_bytes = sum(
+            s.data.nbytes
+            for leaf in jax.tree.leaves(state.opt_state)
+            for s in leaf.addressable_shards[:1]
+        )
+        arms[arm] = {
+            "t_update_ms": round(
+                measure_update_ms(
+                    tx, mesh, cfg.compression, state, sharded, rounds=rounds
+                ),
+                3,
+            ),
+            "opt_state_bytes_per_device": opt_bytes,
+        }
+    report = {
+        "bench": name,
+        "devices": n_devices,
+        "backend": jax.default_backend(),
+        "codec": spec["compression"],
+        "params": int(
+            sum(int(np.prod(l.shape)) for l in jax.tree.leaves(state0.params))
+        ),
+        "arms": arms,
+        "opt_state_reduction_x": round(
+            arms["off"]["opt_state_bytes_per_device"]
+            / max(arms["on"]["opt_state_bytes_per_device"], 1),
+            2,
+        ),
+    }
+    if out_path:
+        import os
+
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=2)
+    return {
+        "metric": "update_ms_per_step",
+        "value": arms["on"]["t_update_ms"],
+        "unit": "ms",
+        "replicated_ms": arms["off"]["t_update_ms"],
+        "opt_state_reduction_x": report["opt_state_reduction_x"],
+        "devices": n_devices,
+    }
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--all", action="store_true", help="run the whole zoo")
     p.add_argument(
         "--scaling", action="store_true", help="virtual-device DP scaling checks"
     )
+    p.add_argument(
+        "--shard-update",
+        choices=("auto", "on", "off"),
+        default="auto",
+        help="ZeRO-1 sharded optimizer update for the benched step "
+        "(auto: on for multi-device meshes — docs/SHARDING.md)",
+    )
+    p.add_argument(
+        "--update-ab",
+        action="store_true",
+        help="A/B the weight-update path (replicated vs sharded) and print "
+        "the update_ms_per_step contract line",
+    )
+    p.add_argument(
+        "--update-ab-out",
+        default="docs/sharding/update_ab.json",
+        help="committed artifact path for --update-ab",
+    )
+    p.add_argument(
+        "--devices",
+        type=int,
+        default=0,
+        help="force an N-device virtual CPU mesh (testing/A-B on hosts "
+        "without accelerators); 0 = use the real backend",
+    )
     p.add_argument("--rounds", type=int, default=TIMED_ROUNDS)
     args = p.parse_args()
+
+    if args.devices:
+        from ddlpc_tpu.utils.compat import force_cpu_devices
+
+        force_cpu_devices(args.devices)
+
+    if args.update_ab:
+        print(json.dumps(run_update_ab(args.rounds, args.update_ab_out)))
+        return
 
     if not args.scaling:
         # Deadline-bounded backend probe: a wedged device tunnel blocks
@@ -409,13 +582,20 @@ def main() -> None:
             print(json.dumps(rec))
         return
     if args.all:
-        results = [run_bench(name, args.rounds) for name in BENCHES]
+        results = [
+            run_bench(name, args.rounds, shard_update=args.shard_update)
+            for name in BENCHES
+        ]
         for rec in results:
             print(json.dumps(rec))
         with open("bench_results.json", "w") as f:
             json.dump(results, f, indent=2)
         return
-    print(json.dumps(run_bench(HEADLINE, args.rounds)))
+    print(
+        json.dumps(
+            run_bench(HEADLINE, args.rounds, shard_update=args.shard_update)
+        )
+    )
 
 
 if __name__ == "__main__":

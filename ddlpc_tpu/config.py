@@ -257,6 +257,21 @@ class ParallelConfig:
     data_axis_name: str = "data"
     space_axis_name: str = "space"
     sync_batch_norm: bool = True  # reference lets BN stats drift per replica (SURVEY §3.1)
+    # ZeRO-1 cross-replica sharded optimizer update (docs/SHARDING.md,
+    # arxiv 2004.13336): reduce-scatter the gradient mean, keep the Adam
+    # moments sharded 1/N per replica (never materialized replicated
+    # between steps), update each replica's shard, all-gather the fresh
+    # params.  Same communication volume as the all-reduce it replaces
+    # (all-reduce ≡ reduce-scatter + all-gather); optimizer-state HBM and
+    # update FLOPs divide by the data-axis size.  Bit-identical to the
+    # replicated update for every codec mode (test-pinned); checkpoints
+    # are layout-independent (always stored gathered).
+    # 'auto' (default): on for data meshes > 1, off for singleton meshes
+    # and for the two codec combinations the shard_map path cannot
+    # reproduce bit-identically (transport='ring'; codec_backend='pallas'
+    # with quantize_mean) — explicit 'on' refuses those loudly instead
+    # (parallel/shard_update.py:resolve_shard_update).
+    shard_update: str = "auto"  # auto | on | off
 
 
 @dataclass(frozen=True)

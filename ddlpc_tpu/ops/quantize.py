@@ -79,11 +79,25 @@ def quantize_with_scale(
 
 
 def snap_to_lattice(
-    scaled: jax.Array, levels: float, key: Optional[jax.Array] = None
+    scaled: jax.Array,
+    levels: float,
+    key: Optional[jax.Array] = None,
+    noise: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Snap values already in lattice units to integers, clipped to ±levels
-    (nearest without a key; stochastic floor(v + U[0,1)) with one)."""
-    if key is None:
+    (nearest without a key; stochastic floor(v + U[0,1)) with one).
+
+    ``noise`` supplies a precomputed U[0,1) field instead of drawing one
+    from ``key`` — the sharded-update path (grad_sync.sync_gradients_scatter)
+    draws the noise at the FULL leaf's shape and slices each replica's
+    chunk, so a shard's rounding decisions are bit-identical to the
+    corresponding elements of the replicated path's draw.  Mutually
+    exclusive with ``key``."""
+    if noise is not None:
+        if key is not None:
+            raise ValueError("pass either key or noise, not both")
+        snapped = jnp.floor(scaled + noise)
+    elif key is None:
         snapped = jnp.round(scaled)
     else:
         snapped = jnp.floor(scaled + jax.random.uniform(key, scaled.shape))
@@ -154,13 +168,20 @@ def encode(
 
 
 def decode(enc: Encoded, cfg: CompressionConfig) -> PyTree:
-    """Dequantize: q / levels * scale (кластер.py:533,543)."""
+    """Dequantize: q · (scale / levels) — the reference's q / levels · scale
+    (кластер.py:533,543) algebraically, restructured as ONE elementwise
+    multiply by a runtime scalar.  The direct form divides by the
+    compile-time CONSTANT ``levels``, which LLVM may rewrite to a
+    reciprocal multiply in one compilation and not another (fast-math is
+    shape/context dependent) — observed as 1-ulp drift between the
+    replicated and ZeRO-sharded train steps dequantizing identical lattice
+    points.  A runtime-scalar multiply has exactly one rounding and no
+    rewritable constant divisor, so every program dequantizes
+    bit-identically (docs/SHARDING.md)."""
     if cfg.mode == "none":
         return enc.tree
-    levels = float(levels_for(cfg))
-    return jax.tree.map(
-        lambda q: q.astype(jnp.float32) / levels * enc.scale, enc.tree
-    )
+    step = enc.scale / float(levels_for(cfg))
+    return jax.tree.map(lambda q: q.astype(jnp.float32) * step, enc.tree)
 
 
 def fake_quantize(

@@ -222,5 +222,7 @@ def ring_allreduce_mean_quantized(
         idx = (rank - k + 2) % axis_size  # chunk owned by rank r-k
         out = lax.dynamic_update_index_in_dim(out, travelling, idx, axis=0)
 
-    mean_flat = out.reshape(-1)[:n].astype(jnp.float32) / levels * scale
+    # Runtime-scalar multiply, quantize.decode's formula — the constant-
+    # divisor form is not LLVM-rewrite-stable across programs (see decode).
+    mean_flat = out.reshape(-1)[:n].astype(jnp.float32) * (scale / levels)
     return _unflatten(mean_flat, shapes, treedef)

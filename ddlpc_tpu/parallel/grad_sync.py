@@ -27,10 +27,20 @@ import functools
 from typing import Any, Optional
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 
 from ddlpc_tpu.config import CompressionConfig
-from ddlpc_tpu.ops.quantize import fake_quantize, rounding_key
+from ddlpc_tpu.ops.quantize import (
+    _leaf_keys,
+    fake_quantize,
+    global_absmax,
+    levels_for,
+    rounding_key,
+    safe_divisor,
+    snap_to_lattice,
+)
+from ddlpc_tpu.parallel.shard_update import chunk_leaf, local_chunk
 
 PyTree = Any
 
@@ -121,8 +131,143 @@ def sync_gradients(
         # make identical decisions.
         local_key = jax.random.fold_in(local_key, lax.axis_index(axis_name))
     if compression.quantize_local:
-        grads = fq(grads, compression, key=local_key)
+        grads = apply_codec_fenced(fq, grads, compression, key=local_key)
     grads = lax.pmean(grads, axis_name)
     if compression.quantize_mean:
-        grads = fq(grads, compression, key=mean_key)
+        grads = apply_codec_fenced(fq, grads, compression, key=mean_key)
     return grads
+
+
+def apply_codec_fenced(fq, grads: PyTree, compression, key=None) -> PyTree:
+    """Run a fake-quantize stage inside ``lax.optimization_barrier`` fences.
+
+    The barriers pin the codec's elementwise chain (scale divide, lattice
+    snap, cast, dequantize) into an isolated fusion region: without them
+    XLA fuses it into the surrounding collectives, and the replicated and
+    sharded-update programs then round the SAME codec arithmetic
+    differently (1-ulp FMA/fusion drift — the same effect documented at
+    train_step._fenced_update, observed on both the shard_map and GSPMD
+    paths).  Every step variant quantizes through this wrapper so the
+    codec's bits cannot depend on which program surrounds it."""
+    if compression.mode == "none":
+        return fq(grads, compression, key=key)
+    grads = lax.optimization_barrier(grads)
+    return lax.optimization_barrier(fq(grads, compression, key=key))
+
+
+def validate_scatter_compression(compression: CompressionConfig) -> None:
+    """Reject codec combinations the sharded update cannot reproduce
+    bit-identically (shared by the step builders, for a build-time error,
+    and sync_gradients_scatter, so the invariant cannot be bypassed).
+    ``shard_update.resolve_shard_update``'s 'auto' avoids both."""
+    if compression.transport not in ("simulate", "ring"):
+        raise ValueError(
+            f"unknown compression transport {compression.transport!r} "
+            "(expected 'simulate' or 'ring')"
+        )
+    if compression.transport == "ring" and compression.mode != "none":
+        raise ValueError(
+            "sharded update composes only with transport='simulate' — "
+            "transport='ring' owns its own full-tree quantized collective "
+            "(set shard_update='off' to keep the ring)"
+        )
+    if (
+        compression.mode != "none"
+        and compression.quantize_mean
+        and compression.codec_backend == "pallas"
+    ):
+        raise ValueError(
+            "sharded update cannot reproduce the pallas mean-stage codec "
+            "bit-identically (hardware-PRNG noise cannot be sliced to a "
+            "shard) — use codec_backend='xla' or shard_update='off'"
+        )
+
+
+def sync_gradients_scatter(
+    grads: PyTree,
+    axis_name: str,
+    compression: CompressionConfig,
+    axis_size: int,
+    key: Optional[jax.Array] = None,
+) -> PyTree:
+    """Reduce-scatter variant of :func:`sync_gradients` for the ZeRO-1
+    sharded update (shard_update.py): instead of every replica receiving
+    the full codec-processed mean, replica ``r`` receives ONLY its ``[1, K]``
+    chunk of each leaf (chunk layout per ``shard_update.chunk_leaf``) —
+    same wire volume as the all-reduce's reduce-scatter half, 1/N of the
+    post-reduce arithmetic and memory per replica.
+
+    Codec loss points map exactly onto :func:`sync_gradients` and are
+    BIT-IDENTICAL per element to the replicated path (test-pinned):
+
+    - ``quantize_local`` runs on the full per-replica gradients *before*
+      the scatter — identical tensors, identical call.
+    - ``quantize_mean`` runs on each replica's chunk of the mean with the
+      GLOBAL scale (``lax.pmax`` of the per-chunk abs-maxes reproduces the
+      whole-model max exactly — max is associative) and, for stochastic
+      rounding, the replica's slice of the full leaf's threefry noise
+      field (drawn at full shape from the shared mean key, then chunked —
+      a shard-shaped draw would decide differently than the replicated
+      path).  The scattered sum itself is bit-identical to ``psum`` on
+      XLA's backends (both accumulate in ring order; pinned by the
+      shard-vs-replicated identity tests).
+
+    ``transport='ring'`` and the pallas mean-stage are rejected — see
+    ``shard_update.resolve_shard_update`` for why they cannot compose.
+    """
+    validate_scatter_compression(compression)
+    fq = resolve_codec_backend(compression)
+    if compression.mode != "none":
+        key = rounding_key(compression, key)
+    local_key = mean_key = None
+    if key is not None:
+        local_key, mean_key = jax.random.split(key)
+        # Same decorrelation as sync_gradients: local noise per replica,
+        # mean noise shared (every replica slices the same field).
+        local_key = jax.random.fold_in(local_key, lax.axis_index(axis_name))
+    if compression.quantize_local:
+        grads = apply_codec_fenced(fq, grads, compression, key=local_key)
+    # Reduce-scatter the mean: chunk each leaf [N, K] and let replica r keep
+    # the summed row r.  Division by the static axis size matches pmean's.
+    shards = jax.tree.map(
+        lambda g: lax.psum_scatter(
+            chunk_leaf(g.astype(jnp.float32), axis_size), axis_name,
+            scatter_dimension=0, tiled=True,
+        ) / axis_size,
+        grads,
+    )
+    if compression.quantize_mean and compression.mode != "none":
+        levels = float(levels_for(compression))
+        out_dtype = jnp.int8 if compression.mode == "int8" else jnp.float16
+        # Same fusion fence as apply_codec_fenced, cut at the same points
+        # (chunk mean in, dequantized chunk mean out) so the per-element
+        # quantization arithmetic compiles identically to the replicated
+        # path's region.
+        shards = lax.optimization_barrier(shards)
+        # Global (whole-model) scale, exactly global_absmax of the full
+        # mean tree: padding rows are zero and max is order-independent.
+        scale = lax.pmax(global_absmax(shards), axis_name)
+        safe = safe_divisor(scale)
+        mean_keys = _leaf_keys(shards, mean_key)
+
+        def q_shard(shard, g_full, subkey):
+            noise = None
+            if subkey is not None:
+                # Draw at the FULL leaf shape (same counters as the
+                # replicated path's draw), then slice this replica's chunk.
+                noise = local_chunk(
+                    jax.random.uniform(subkey, g_full.shape),
+                    axis_size,
+                    axis_name,
+                )
+            scaled = shard.astype(jnp.float32) / safe * levels
+            q = snap_to_lattice(scaled, levels, noise=noise).astype(out_dtype)
+            # Single runtime-scalar multiply, exactly quantize.decode's
+            # formula (constant-divisor division is not rewrite-stable
+            # across programs — see decode's docstring).
+            return q.astype(jnp.float32) * (scale / levels)
+
+        shards = lax.optimization_barrier(
+            jax.tree.map(q_shard, shards, grads, mean_keys)
+        )
+    return shards

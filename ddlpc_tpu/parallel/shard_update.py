@@ -1,0 +1,414 @@
+"""ZeRO-1 cross-replica sharding of the optimizer update.
+
+Data-parallel training replicates the optimizer state and redundantly runs
+the identical weight update on every replica — for Adam that is 2× the
+model in fp32 moments per device plus N copies of the same update FLOPs.
+"Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training" (arxiv 2004.13336, PAPERS.md) observes the update is elementwise,
+so it can be *sharded*: reduce-scatter the gradients (each replica receives
+the mean of 1/N of the elements), update 1/N of the parameters and moments,
+then all-gather the fresh parameters for the next forward.  Communication
+volume is unchanged (all-reduce ≡ reduce-scatter + all-gather); optimizer
+HBM and update FLOPs divide by N.
+
+This module owns the *layout*: every parameter leaf is flattened, padded to
+a multiple of the data-axis size N, and viewed as ``[N, K]`` chunks — row
+``r`` is replica ``r``'s shard.  Row-major flattening makes the chunk view
+of an already-``[N, K]``-shaped leaf the identity, so the rule "an optimizer
+leaf is chunked iff its unsharded shape equals some parameter's shape"
+(Adam's ``mu``/``nu`` and SGD's ``trace`` mirror the parameter tree;
+``count`` and the schedule scalars do not) is unambiguous.  The arithmetic
+lives in ``grad_sync.sync_gradients_scatter`` and the step builders
+(``train_step.py``); checkpoints always store the canonical *gathered*
+layout, so on-disk blobs are layout-independent (docs/SHARDING.md).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddlpc_tpu.config import CompressionConfig
+
+PyTree = Any
+
+
+# ---------------------------------------------------------------------------
+# chunk layout primitives
+
+
+def chunk_rows(n_elements: int, n_shards: int) -> int:
+    """K: elements per shard for an ``n_elements`` leaf over ``n_shards``."""
+    return -(-n_elements // n_shards)
+
+
+def chunk_leaf(x: jax.Array, n_shards: int) -> jax.Array:
+    """Flatten ``x`` row-major, zero-pad to a multiple of ``n_shards``, and
+    view as ``[n_shards, K]`` — row ``r`` is replica ``r``'s shard."""
+    x = jnp.asarray(x)
+    k = chunk_rows(x.size, n_shards)
+    flat = x.reshape(-1)
+    pad = n_shards * k - x.size
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(n_shards, k)
+
+
+def unchunk_leaf(chunked: jax.Array, shape: Tuple[int, ...]) -> jax.Array:
+    """Inverse of :func:`chunk_leaf`: drop the padding, restore ``shape``."""
+    size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    return chunked.reshape(-1)[:size].reshape(shape)
+
+
+def local_chunk(x: jax.Array, n_shards: int, axis_name: str) -> jax.Array:
+    """This replica's ``[1, K]`` row of ``x``'s chunk view — call inside
+    shard_map (uses ``lax.axis_index``)."""
+    from jax import lax
+
+    return lax.dynamic_slice_in_dim(
+        chunk_leaf(x, n_shards), lax.axis_index(axis_name), 1, axis=0
+    )
+
+
+# ---------------------------------------------------------------------------
+# which optimizer-state leaves are sharded
+
+
+def param_shapes(params: PyTree) -> frozenset:
+    return frozenset(tuple(l.shape) for l in jax.tree.leaves(params))
+
+
+def validate_zero1_params(params: PyTree) -> None:
+    """Refuse 0-d parameters in the zero1 layout, loudly: the chunk rule
+    identifies an optimizer leaf as a moment by its parameter shape, and
+    ``chunkable`` excludes ``()`` precisely because Adam's ``count`` and
+    schedule scalars are also ``()`` — a 0-d *parameter* would make its
+    moments ambiguous with those (and the chunked grads/params would then
+    diverge in shape from the unchunked moments inside ``tx.update``).  No
+    model in this repo has scalar learnables; if one appears, reshape it to
+    ``(1,)`` or run with ``shard_update='off'``."""
+    bad = [
+        jax.tree_util.keystr(path)
+        for path, l in jax.tree_util.tree_leaves_with_path(params)
+        if len(l.shape) == 0
+    ]
+    if bad:
+        raise ValueError(
+            f"shard_update (zero1 layout) cannot represent 0-d parameters "
+            f"{bad} — reshape them to (1,) or set shard_update='off' "
+            f"(parallel/shard_update.py:validate_zero1_params)"
+        )
+
+
+def chunkable(shape: Tuple[int, ...], pshapes: frozenset) -> bool:
+    """A (full-layout) optimizer leaf is sharded iff it is parameter-shaped:
+    Adam/SGD moments mirror the parameter tree leaf-for-leaf; step counters
+    and schedule scalars are not parameter-shaped and stay replicated."""
+    return len(shape) > 0 and tuple(shape) in pshapes
+
+
+def opt_state_template(tx, params: PyTree) -> PyTree:
+    """Abstract full-layout opt_state (shapes/dtypes only, no allocation) —
+    the reference against which chunked leaves are recognized and
+    un-chunked (it carries their original shapes)."""
+    shapes = jax.tree.map(
+        lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params
+    )
+    return jax.eval_shape(tx.init, shapes)
+
+
+# ---------------------------------------------------------------------------
+# config resolution
+
+
+def resolve_shard_update(
+    mode: str,
+    compression: CompressionConfig,
+    data_size: int,
+    spatial: bool,
+    grad_clip_norm: float = 0.0,
+) -> bool:
+    """Resolve ``ParallelConfig.shard_update`` ∈ {auto, on, off} to a bool.
+
+    ``auto`` (the default) turns sharding on for data meshes > 1 and off
+    for singleton meshes and for the three combinations the shard_map
+    path cannot reproduce bit-identically (explicit ``on`` refuses those
+    loudly instead of silently changing semantics):
+
+    - ``transport='ring'``: the ring owns its own full-tree quantized
+      reduce-scatter/all-gather (compressed_allreduce.py) whose integer
+      wire sums are defined over whole leaves — splitting the mean stage
+      across replicas would change which elements share a wire word.
+    - ``codec_backend='pallas'`` with ``quantize_mean``: the kernel draws
+      its rounding noise from the TPU hardware PRNG per block, which
+      cannot be sliced to a replica's shard of the mean; the XLA backend's
+      threefry field can (grad_sync.sync_gradients_scatter).
+    - ``grad_clip_norm > 0``: ``optax.clip_by_global_norm`` runs *inside*
+      ``tx.update``, which the chunked path calls on each replica's 1/N
+      shard — every replica would clip by the norm of its own shard
+      instead of the global norm (wrong threshold, replica-divergent
+      updates).  The clip stage cannot see the cross-replica sum from
+      inside an opaque optax chain.
+
+    The GSPMD (spatial) path has none of these constraints: its codec and
+    optimizer run on the full logical arrays inside the partitioned
+    program (``optax.global_norm`` there is a partitioner-inserted psum),
+    so only the mesh size gates.
+    """
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(
+            f"unknown shard_update {mode!r} (expected 'auto', 'on' or 'off')"
+        )
+    if mode == "off":
+        return False
+    incompatible = None
+    if not spatial and compression.mode != "none":
+        if compression.transport == "ring":
+            incompatible = (
+                "transport='ring' — the ring all-reduce owns its own "
+                "quantized reduce-scatter/all-gather over whole leaves"
+            )
+        elif compression.quantize_mean and compression.codec_backend == "pallas":
+            incompatible = (
+                "codec_backend='pallas' with quantize_mean — the kernel's "
+                "hardware-PRNG noise field cannot be sliced to a shard of "
+                "the mean; use codec_backend='xla'"
+            )
+    if not spatial and incompatible is None and grad_clip_norm:
+        incompatible = (
+            "grad_clip_norm > 0 — optax.clip_by_global_norm inside "
+            "tx.update would clip each replica's 1/N shard by its own "
+            "partial norm, not the global norm; use a data×space mesh "
+            "(GSPMD path) or disable clipping"
+        )
+    if mode == "on":
+        if incompatible:
+            raise ValueError(
+                f"shard_update='on' cannot compose with {incompatible}; set "
+                f"shard_update='off' (or 'auto', which resolves it)"
+            )
+        # Singleton mesh: sharding into 1 shard is the replicated program —
+        # fall back to it rather than carry a degenerate chunk layout.
+        return data_size > 1
+    return data_size > 1 and incompatible is None
+
+
+# ---------------------------------------------------------------------------
+# state layout: replicated | zero1 (chunked, shard_map) | gspmd (leaf-sharded)
+
+
+def opt_leaf_spec(
+    shape: Tuple[int, ...],
+    pshapes: frozenset,
+    layout: str,
+    n_shards: int,
+    data_axis: str,
+) -> Optional[P]:
+    """Run-layout partition spec for ONE full-layout optimizer leaf — the
+    single owner of the which-leaves-shard-and-how decision, shared by
+    every site that builds opt-state specs (``StateLayout``, both step
+    builders, ``make_update_step``) so the trainer's placement and the
+    steps' in/out specs cannot drift apart.  Returns ``None`` for leaves
+    that are not parameter-shaped (step counters, schedule scalars): they
+    stay replicated and get no sharding constraint."""
+    if not chunkable(shape, pshapes):
+        return None
+    if layout == "zero1":
+        return P(data_axis)
+    return zero_leaf_spec(shape, n_shards, data_axis)
+
+
+def opt_partition_specs(
+    tx, params: PyTree, layout: str, data_axis: str, n_shards: int = 1
+) -> PyTree:
+    """PartitionSpec tree over the full-layout opt_state template for the
+    run ``layout`` (shard_map in_specs/out_specs form; non-param-shaped
+    leaves → ``P()``).  ``n_shards`` only matters for ``layout='gspmd'``."""
+    if layout == "zero1":
+        validate_zero1_params(params)
+    template = opt_state_template(tx, params)
+    pshapes = param_shapes(params)
+
+    def leaf(t):
+        sp = opt_leaf_spec(t.shape, pshapes, layout, n_shards, data_axis)
+        return P() if sp is None else sp
+
+    return jax.tree.map(leaf, template)
+
+
+def _map_opt_shardings(
+    template: PyTree, pshapes: frozenset, layout: str, mesh: Mesh,
+    data_axis: str,
+) -> PyTree:
+    """Map :func:`opt_leaf_spec` over a full-layout opt_state template as a
+    NamedSharding tree — the one implementation behind both the function
+    and :class:`StateLayout` forms, so they cannot drift."""
+    repl = NamedSharding(mesh, P())
+    if layout == "replicated":
+        return jax.tree.map(lambda t: repl, template)
+    n = mesh.shape[data_axis]
+
+    def leaf(t):
+        sp = opt_leaf_spec(t.shape, pshapes, layout, n, data_axis)
+        return repl if sp is None else NamedSharding(mesh, sp)
+
+    return jax.tree.map(leaf, template)
+
+
+def opt_shardings(
+    tx, params: PyTree, layout: str, mesh: Mesh, data_axis: str
+) -> PyTree:
+    """NamedSharding tree (jit in_shardings / device_put form) for the run
+    ``layout`` of the optimizer state — same decisions as
+    :func:`opt_partition_specs`, mesh-attached."""
+    return _map_opt_shardings(
+        opt_state_template(tx, params), param_shapes(params), layout, mesh,
+        data_axis,
+    )
+
+
+def zero_leaf_spec(
+    shape: Tuple[int, ...], n_shards: int, data_axis: str
+) -> P:
+    """GSPMD ZeRO spec for a param-shaped optimizer leaf: partition the
+    largest dimension that divides evenly by the data axis (falling back to
+    the largest dimension ≥ N — GSPMD pads uneven shards); leaves with no
+    dimension ≥ N stay replicated (nothing meaningful to split)."""
+    if not shape:
+        return P()
+    dims = sorted(range(len(shape)), key=lambda d: shape[d], reverse=True)
+    pick = None
+    for d in dims:
+        if shape[d] >= n_shards and shape[d] % n_shards == 0:
+            pick = d
+            break
+    if pick is None:
+        pick = next((d for d in dims if shape[d] >= n_shards), None)
+    if pick is None:
+        return P()
+    spec = [None] * len(shape)
+    spec[pick] = data_axis
+    return P(*spec)
+
+
+class StateLayout:
+    """Converts a ``TrainState`` between the canonical replicated layout
+    (what checkpoints store, what ``create_train_state`` builds) and the
+    run layout the train step consumes.
+
+    - ``mode='replicated'``: run layout == canonical layout.
+    - ``mode='zero1'`` (shard_map step): opt-state moments live as
+      ``[N, K]`` chunk leaves sharded ``P(data)`` over the mesh — each
+      device holds one ``[1, K]`` row; params stay replicated (the forward
+      needs them whole).
+    - ``mode='gspmd'``: opt-state moments keep their parameter shapes but
+      are partitioned ``P(..., data, ...)`` per :func:`zero_leaf_spec`; the
+      XLA partitioner inserts the reduce-scatter/all-gather around the
+      update on its own.
+
+    ``place``/``canonical`` are jitted once and cached — at checkpoint
+    cadence a retrace per save would otherwise recompile the gather every
+    epoch.  Both are collectives under multi-host meshes, so every process
+    must call them (Trainer.save/restore do).
+    """
+
+    def __init__(
+        self,
+        mode: str,
+        tx,
+        state: PyTree,
+        mesh: Mesh,
+        data_axis: str = "data",
+    ):
+        if mode not in ("replicated", "zero1", "gspmd"):
+            raise ValueError(f"unknown state layout {mode!r}")
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.n = mesh.shape[data_axis]
+        # Singleton data mesh: one shard IS the replicated layout — mirror
+        # the step builders' fallback so layout and step cannot disagree.
+        self.mode = mode if self.n > 1 else "replicated"
+        if self.mode == "zero1":
+            validate_zero1_params(state.params)
+        self._repl = NamedSharding(mesh, P())
+        self._template = opt_state_template(tx, state.params)
+        self._pshapes = param_shapes(state.params)
+        self._place_fn = None
+        self._canonical_fn = None
+
+    # -- sharding trees -----------------------------------------------------
+
+    def _opt_shardings(self) -> PyTree:
+        return _map_opt_shardings(
+            self._template, self._pshapes, self.mode, self.mesh,
+            self.data_axis,
+        )
+
+    def state_shardings(self, state: PyTree) -> PyTree:
+        """Per-leaf NamedSharding tree for the RUN layout of ``state``."""
+        return state.replace(
+            step=self._repl,
+            params=jax.tree.map(lambda _: self._repl, state.params),
+            batch_stats=jax.tree.map(lambda _: self._repl, state.batch_stats),
+            opt_state=self._opt_shardings(),
+        )
+
+    # -- layout conversion --------------------------------------------------
+
+    def place(self, state: PyTree) -> PyTree:
+        """Canonical (full, replicated-shape) state → run layout on mesh."""
+        if self.mode == "replicated":
+            return jax.device_put(state, self._repl)
+        if self._place_fn is None:
+            shardings = self.state_shardings(state)
+            if self.mode == "zero1":
+                n = self.n
+
+                def to_run(s):
+                    opt = jax.tree.map(
+                        lambda t, l: chunk_leaf(l, n)
+                        if chunkable(t.shape, self._pshapes)
+                        else l,
+                        self._template,
+                        s.opt_state,
+                    )
+                    return s.replace(opt_state=opt)
+
+            else:  # gspmd: same shapes, different placement
+
+                def to_run(s):
+                    return s
+
+            self._place_fn = jax.jit(to_run, out_shardings=shardings)
+        return self._place_fn(state)
+
+    def canonical(self, state: PyTree) -> PyTree:
+        """Run layout → canonical full replicated layout (the checkpoint/
+        broadcast layout).  For sharded modes this compiles to an
+        all-gather of the moments — transiently materializing the full
+        optimizer state once per checkpoint, never per step."""
+        if self.mode == "replicated":
+            return state
+        if self._canonical_fn is None:
+            if self.mode == "zero1":
+                def to_full(s):
+                    opt = jax.tree.map(
+                        lambda t, l: unchunk_leaf(l, t.shape)
+                        if chunkable(t.shape, self._pshapes)
+                        else l,
+                        self._template,
+                        s.opt_state,
+                    )
+                    return s.replace(opt_state=opt)
+
+            else:
+
+                def to_full(s):
+                    return s
+
+            self._canonical_fn = jax.jit(to_full, out_shardings=self._repl)
+        return self._canonical_fn(state)

@@ -37,7 +37,8 @@ from ddlpc_tpu.models.layers import group_labels
 from ddlpc_tpu.utils.compat import shard_map
 from ddlpc_tpu.ops.losses import nll_correct_valid, softmax_cross_entropy_sum
 from ddlpc_tpu.ops.metrics import confusion_from_logits
-from ddlpc_tpu.parallel.grad_sync import sync_gradients
+from ddlpc_tpu.parallel.grad_sync import sync_gradients, sync_gradients_scatter
+from ddlpc_tpu.parallel import shard_update as zero
 
 PyTree = Any
 
@@ -202,6 +203,96 @@ def _accumulate_grads(
     return grads, batch_stats, losses, accs
 
 
+def _fenced_update(
+    tx: optax.GradientTransformation,
+    grads: PyTree,
+    opt_state: PyTree,
+    params: PyTree,
+) -> Tuple[PyTree, PyTree]:
+    """tx.update + apply_updates inside ``lax.optimization_barrier`` fences.
+
+    The barriers pin the optimizer arithmetic into an isolated fusion
+    region: without them XLA fuses the elementwise Adam chain into its
+    *surrounding* ops — the all-reduce consumer in the replicated step, the
+    reduce-scatter/all-gather pair in the sharded one — and the two
+    programs then contract mul+add into FMA differently on small leaves,
+    producing 1-ulp drift between layouts (observed on the CPU backend:
+    identical mean gradients and moments in, updates differing by 1 ulp on
+    bias/BatchNorm leaves from step 2 on).  With the fence the update
+    subprogram is bit-identical across layouts — the property the
+    shard-vs-replicated identity tests and cross-layout checkpoint
+    restores rely on.  Perf cost: none measurable (the update is a few
+    fused elementwise loops either side of the fence).
+    """
+    grads, opt_state, params = lax.optimization_barrier(
+        (grads, opt_state, params)
+    )
+    updates, new_opt = tx.update(grads, opt_state, params)
+    new_params = optax.apply_updates(params, updates)
+    return lax.optimization_barrier((new_params, new_opt))
+
+
+def _psum_sq_norm(tree: PyTree, axis_name: str) -> jax.Array:
+    """Global gradient norm from per-replica partial sums of squares —
+    under the sharded update each replica only holds 1/N of the mean
+    gradient, so the squared partials are psum'd before the sqrt to keep
+    the logged ``grad_norm`` comparable across all step variants."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    sq = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    return jnp.sqrt(lax.psum(sq, axis_name))
+
+
+def _apply_update_sharded(
+    tx: optax.GradientTransformation,
+    params: PyTree,
+    opt_state: PyTree,
+    grads: PyTree,
+    data_axis: str,
+    axis_size: int,
+    compression: CompressionConfig,
+    key,
+):
+    """The ZeRO-1 weight-update path, called inside shard_map with LOCAL
+    values: full per-replica ``grads``/``params``, this replica's ``[1, K]``
+    chunks of the optimizer moments in ``opt_state``.  Returns the fresh
+    full params (all-gathered), the updated local moment chunks, and the
+    psum'd grad norm of the post-codec mean.  Shared by the train step and
+    the update-only bench program so their semantics cannot diverge."""
+    grad_shards = sync_gradients_scatter(
+        grads, data_axis, compression, axis_size=axis_size, key=key
+    )
+    param_shards = jax.tree.map(
+        lambda p: zero.local_chunk(p, axis_size, data_axis), params
+    )
+    new_param_shards, new_opt = _fenced_update(
+        tx, grad_shards, opt_state, param_shards
+    )
+    new_params = jax.tree.map(
+        lambda sh, p: zero.unchunk_leaf(
+            lax.all_gather(sh, data_axis, axis=0, tiled=True), p.shape
+        ),
+        new_param_shards,
+        params,
+    )
+    return new_params, new_opt, _psum_sq_norm(grad_shards, data_axis)
+
+
+def _zero1_state_specs(
+    state: TrainState, tx: optax.GradientTransformation, data_axis: str
+) -> TrainState:
+    """shard_map partition specs for the ZeRO-1 run layout: params/stats/
+    step replicated, chunked opt-state moments split over ``data_axis``.
+    Built at trace time from the state's avals (the chunk-vs-scalar
+    decision needs the abstract full-layout opt_state, shard_update.py)."""
+    opt_specs = zero.opt_partition_specs(tx, state.params, "zero1", data_axis)
+    return state.replace(
+        step=P(),
+        params=jax.tree.map(lambda _: P(), state.params),
+        batch_stats=jax.tree.map(lambda _: P(), state.batch_stats),
+        opt_state=opt_specs,
+    )
+
+
 def make_train_step(
     model: nn.Module,
     tx: optax.GradientTransformation,
@@ -211,6 +302,7 @@ def make_train_step(
     donate_state: bool = True,
     remat: bool = False,
     seed: int = 0,
+    shard_update: bool = False,
 ) -> Callable[[TrainState, jax.Array, jax.Array], Tuple[TrainState, dict]]:
     """Build the jitted SPMD train step.
 
@@ -220,6 +312,23 @@ def make_train_step(
     ``frequency_sending_gradients`` кластер.py:685), B = *global* micro-batch,
     sharded over the data axis.
     Returns (new_state, metrics) with metrics averaged over A and the mesh.
+
+    ``shard_update=True`` selects the ZeRO-1 sharded weight update
+    (shard_update.py, docs/SHARDING.md): the gradient pmean becomes a
+    reduce-scatter, each replica updates its 1/N chunk of params/moments,
+    and an all-gather publishes the fresh params — the state's opt_state
+    must be in the chunked run layout (``shard_update.StateLayout``).
+    Bit-identical to the replicated update for every supported codec mode
+    (test-pinned); on a singleton data mesh it falls back to the
+    replicated program (sharding into one shard IS replication).
+
+    Precondition on ``tx`` (uncheckable — optax chains are opaque): no
+    stage may couple elements across the tree, e.g. ``clip_by_global_norm``
+    — under the sharded update each replica's ``tx.update`` sees only its
+    1/N chunk, so a global-norm clip would use the shard's partial norm
+    (wrong threshold, replica-divergent params).  The config path enforces
+    this via ``resolve_shard_update(grad_clip_norm=...)``; direct callers
+    own it.
     """
     for name, size in mesh.shape.items():
         if name != data_axis and size > 1:
@@ -228,6 +337,12 @@ def make_train_step(
                 f"shard_map train step — use make_train_step_gspmd for "
                 f"data×space meshes (the Trainer selects it automatically)"
             )
+    axis_size = mesh.shape[data_axis]
+    shard_update = shard_update and axis_size > 1
+    if shard_update:
+        from ddlpc_tpu.parallel.grad_sync import validate_scatter_compression
+
+        validate_scatter_compression(compression)
 
     def shard_body(state: TrainState, images: jax.Array, labels: jax.Array):
         # Inside shard_map: images [A, B_local, H, W, C].
@@ -242,21 +357,27 @@ def make_train_step(
         batch_stats = jax.tree.map(
             lambda x: lax.pmean(x, data_axis), batch_stats
         )
-        # The one collective of the step — replaces reference L0–L4.
+        # The one (logical) collective of the step — replaces reference
+        # L0–L4.  Sharded: reduce-scatter + all-gather, the same wire bytes
+        # split around a 1/N-sized update.
         rng = _rounding_rng(compression, seed, state.step)
-        grads = sync_gradients(
-            grads,
-            data_axis,
-            compression,
-            axis_size=mesh.shape[data_axis],
-            key=rng,
-        )
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+        if shard_update:
+            params, opt_state, grad_norm = _apply_update_sharded(
+                tx, state.params, state.opt_state, grads,
+                data_axis, axis_size, compression, rng,
+            )
+        else:
+            grads = sync_gradients(
+                grads, data_axis, compression, axis_size=axis_size, key=rng
+            )
+            params, opt_state = _fenced_update(
+                tx, grads, state.opt_state, state.params
+            )
+            grad_norm = optax.global_norm(grads)
         metrics = {
             "loss": lax.pmean(losses.mean(), data_axis),
             "pixel_acc": lax.pmean(accs.mean(), data_axis),
-            "grad_norm": optax.global_norm(grads),
+            "grad_norm": grad_norm,
         }
         new_state = TrainState(
             step=state.step + 1,
@@ -266,15 +387,31 @@ def make_train_step(
         )
         return new_state, metrics
 
-    state_spec = P()  # replicated
-    sharded = shard_map(
-        shard_body,
-        mesh=mesh,
-        in_specs=(state_spec, P(None, data_axis), P(None, data_axis)),
-        out_specs=(state_spec, state_spec),
-        check=False,
-    )
-    return jax.jit(sharded, donate_argnums=(0,) if donate_state else ())
+    donate = (0,) if donate_state else ()
+    if not shard_update:
+        sharded = shard_map(
+            shard_body,
+            mesh=mesh,
+            in_specs=(P(), P(None, data_axis), P(None, data_axis)),
+            out_specs=(P(), P()),
+            check=False,
+        )
+        return jax.jit(sharded, donate_argnums=donate)
+
+    def stepper(state: TrainState, images: jax.Array, labels: jax.Array):
+        # Specs depend on the state's (chunked) structure — build them at
+        # trace time from the avals; shard_map composes under jit.
+        specs = _zero1_state_specs(state, tx, data_axis)
+        sharded = shard_map(
+            shard_body,
+            mesh=mesh,
+            in_specs=(specs, P(None, data_axis), P(None, data_axis)),
+            out_specs=(specs, P()),
+            check=False,
+        )
+        return sharded(state, images, labels)
+
+    return jax.jit(stepper, donate_argnums=donate)
 
 
 def make_train_step_gspmd(
@@ -287,6 +424,7 @@ def make_train_step_gspmd(
     donate_state: bool = True,
     remat: bool = False,
     seed: int = 0,
+    shard_update: bool = False,
 ) -> Callable[[TrainState, jax.Array, jax.Array], Tuple[TrainState, dict]]:
     """GSPMD train step: batch sharded over ``data`` AND H over ``space``.
 
@@ -307,6 +445,16 @@ def make_train_step_gspmd(
       per-replica gradient in the program; only ``quantize_mean``
       (кластер.py:328-396) applies.  The shard_map path remains the
       reference-parity codec path.
+
+    ``shard_update=True`` is the GSPMD spelling of ZeRO-1: the optimizer
+    moments stay parameter-shaped but are *partitioned* over ``data_axis``
+    (``shard_update.zero_leaf_spec`` picks the dimension), pinned by
+    sharding constraints on both the incoming state (Trainer placement)
+    and the step's output — the XLA partitioner then materializes the
+    reduce-scatter/all-gather around the elementwise update on its own
+    (the mechanism of arxiv 2004.13336).  The codec still sees the full
+    mean gradient inside the partitioned program, so no codec mode is
+    restricted on this path.
     """
 
     if compression.mode != "none" and not compression.quantize_mean:
@@ -335,19 +483,49 @@ def make_train_step_gspmd(
             "(shard_map step) for reference-parity two-point codec semantics"
         )
 
+    repl = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, P(None, data_axis, space_axis))
+    n_data = mesh.shape[data_axis]
+    shard_update = shard_update and n_data > 1
+
     def step_fn(state: TrainState, images: jax.Array, labels: jax.Array):
         grads, batch_stats, losses, accs = _accumulate_grads(
             model, state, images, labels, remat=remat
         )
         if compression.mode != "none":
-            from ddlpc_tpu.parallel.grad_sync import resolve_codec_backend
+            from ddlpc_tpu.parallel.grad_sync import (
+                apply_codec_fenced,
+                resolve_codec_backend,
+            )
 
             rng = _rounding_rng(compression, seed, state.step)
-            grads = resolve_codec_backend(compression)(
-                grads, compression, key=rng
+            grads = apply_codec_fenced(
+                resolve_codec_backend(compression), grads, compression, key=rng
             )
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+        params, opt_state = _fenced_update(
+            tx, grads, state.opt_state, state.params
+        )
+        if shard_update:
+            # With the output state's shardings unconstrained at the jit
+            # boundary, pin them here: params/stats replicated (the next
+            # forward and eval/predict need them whole), fresh moments in
+            # the ZeRO layout so the partitioner keeps them sharded across
+            # steps (and therefore shards the elementwise update math that
+            # produces them) instead of replicating the output.
+            params = lax.with_sharding_constraint(params, repl)
+            batch_stats = lax.with_sharding_constraint(batch_stats, repl)
+            template = zero.opt_state_template(tx, state.params)
+            pshapes = zero.param_shapes(state.params)
+
+            def constrain(t, l):
+                sp = zero.opt_leaf_spec(
+                    t.shape, pshapes, "gspmd", n_data, data_axis
+                )
+                if sp is None:
+                    return l
+                return lax.with_sharding_constraint(l, NamedSharding(mesh, sp))
+
+            opt_state = jax.tree.map(constrain, template, opt_state)
         metrics = {
             "loss": losses.mean(),
             "pixel_acc": accs.mean(),
@@ -361,14 +539,105 @@ def make_train_step_gspmd(
         )
         return new_state, metrics
 
-    repl = NamedSharding(mesh, P())
-    batch_sh = NamedSharding(mesh, P(None, data_axis, space_axis))
-    return jax.jit(
-        step_fn,
-        in_shardings=(repl, batch_sh, batch_sh),
-        out_shardings=(repl, repl),
-        donate_argnums=(0,) if donate_state else (),
-    )
+    if not shard_update:
+        return jax.jit(
+            step_fn,
+            in_shardings=(repl, batch_sh, batch_sh),
+            out_shardings=(repl, repl),
+            donate_argnums=(0,) if donate_state else (),
+        )
+
+    # Sharded state: the state's sharding tree mixes replicated and
+    # P(data)-partitioned leaves, and its structure is unknown until the
+    # first state arrives — build the jit lazily from that state's tree,
+    # with EXPLICIT and identical in/out shardings.  (Leaving the state
+    # boundary unspecified makes jit infer the donation aliasing across
+    # mismatched layouts, which XLA rejects at dispatch: "aliased input
+    # and output to have the same size".)
+    cache: dict = {}
+
+    def stepper(state: TrainState, images: jax.Array, labels: jax.Array):
+        fn = cache.get("fn")
+        if fn is None:
+            opt_sh = zero.opt_shardings(
+                tx, state.params, "gspmd", mesh, data_axis
+            )
+            state_sh = state.replace(
+                step=repl,
+                params=jax.tree.map(lambda _: repl, state.params),
+                batch_stats=jax.tree.map(lambda _: repl, state.batch_stats),
+                opt_state=opt_sh,
+            )
+            fn = cache["fn"] = jax.jit(
+                step_fn,
+                in_shardings=(state_sh, batch_sh, batch_sh),
+                out_shardings=(state_sh, repl),
+                donate_argnums=(0,) if donate_state else (),
+            )
+        return fn(state, images, labels)
+
+    return stepper
+
+
+def make_update_step(
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    compression: CompressionConfig,
+    data_axis: str = "data",
+    shard_update: bool = False,
+    seed: int = 0,
+) -> Callable[[PyTree, PyTree, PyTree], Tuple[PyTree, PyTree]]:
+    """Update-ONLY SPMD program: (params, opt_state, grads) → (params,
+    opt_state) — the gradient sync + optimizer step with no forward/
+    backward, for benchmarking the weight-update path in isolation
+    (``bench.py --update-ab``, the ``update_ms_per_step`` contract line).
+    ``grads`` is the per-replica accumulated gradient tree (replicated
+    input); ``opt_state`` must be in the matching layout (chunked when
+    ``shard_update``).  Stochastic rounding uses the shared key schedule
+    pinned at step 0 (no step counter flows through this program): every
+    call rounds with the same noise — right for timing the codec's real
+    threefry cost, wrong for training, which the fused steps own.  Same
+    ``tx`` precondition as ``make_train_step``: no cross-tree coupling
+    (e.g. ``clip_by_global_norm``) when ``shard_update``.
+    """
+    axis_size = mesh.shape[data_axis]
+    shard_update = shard_update and axis_size > 1
+    if shard_update:
+        from ddlpc_tpu.parallel.grad_sync import validate_scatter_compression
+
+        validate_scatter_compression(compression)
+
+    def body(params: PyTree, opt_state: PyTree, grads: PyTree):
+        rng = _rounding_rng(compression, seed, 0)
+        if shard_update:
+            params, opt_state, _ = _apply_update_sharded(
+                tx, params, opt_state, grads,
+                data_axis, axis_size, compression, rng,
+            )
+        else:
+            grads = sync_gradients(
+                grads, data_axis, compression, axis_size=axis_size, key=rng
+            )
+            params, opt_state = _fenced_update(tx, grads, opt_state, params)
+        return params, opt_state
+
+    def stepper(params: PyTree, opt_state: PyTree, grads: PyTree):
+        if shard_update:
+            opt_specs = zero.opt_partition_specs(
+                tx, params, "zero1", data_axis
+            )
+        else:
+            opt_specs = P()
+        sharded = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), opt_specs, P()),
+            out_specs=(P(), opt_specs),
+            check=False,
+        )
+        return sharded(params, opt_state, grads)
+
+    return jax.jit(stepper, donate_argnums=(0, 1))
 
 
 def make_eval_step_gspmd(

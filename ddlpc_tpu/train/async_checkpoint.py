@@ -26,6 +26,12 @@ Semantics (tests/test_checkpoint_format.py):
   unrelated to the step-sized stall timeout).
 - **Process gate**: non-zero processes no-op on ``save`` (state is
   replicated; only process 0 writes), matching ``save_checkpoint``.
+- **Canonical layout in**: callers pass the gathered (replicated) state —
+  under the ZeRO-sharded update ``Trainer.save`` first runs
+  ``StateLayout.canonical`` (a collective all processes join), so the
+  snapshot below never sees chunked moments and on-disk blobs stay
+  layout-independent (docs/SHARDING.md); ``snapshot_state`` rejects
+  non-addressable leaves with a pointer to that contract.
 """
 
 from __future__ import annotations

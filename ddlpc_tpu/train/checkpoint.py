@@ -106,6 +106,25 @@ def snapshot_state(state: PyTree) -> dict:
     out = {}
     big = []  # (path, leaf) copies worth parallelizing
     for path, leaf in _flatten_state_dict(serialization.to_state_dict(state)):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_replicated:
+            # Checkpoints store the CANONICAL gathered layout — a ZeRO-
+            # sharded state reaching this point means the caller skipped
+            # the gather (Trainer.save runs StateLayout.canonical, a
+            # collective every process joins, before handing state to the
+            # checkpointer).  Keying on replication alone catches BOTH
+            # failure shapes: multi-host sharded leaves (np.array would
+            # fail deep in jax with no hint at the contract) and
+            # single-host sharded leaves, which np.array would happily
+            # serialize — silently writing chunked moments that cannot
+            # restore into a fresh or differently-sized run.  Replicated
+            # leaves pass everywhere: np.array reads them from the local
+            # shard, which IS the canonical layout this format stores.
+            raise ValueError(
+                f"checkpoint leaf {'/'.join(path)} is not replicated "
+                f"(sharded run layout?) — gather to the canonical layout "
+                f"first (parallel/shard_update.py:StateLayout.canonical; "
+                f"docs/SHARDING.md)"
+            )
         if isinstance(leaf, dict):  # empty-dict leaf (see _flatten_state_dict)
             out[path] = {}
         elif isinstance(leaf, np.generic):

@@ -20,7 +20,6 @@ from typing import Dict, Optional
 
 import jax
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ddlpc_tpu.config import ExperimentConfig
 from ddlpc_tpu.data import ShardedLoader, build_dataset
@@ -32,6 +31,7 @@ from ddlpc_tpu.ops.metrics import (
     mean_iou,
 )
 from ddlpc_tpu.parallel.mesh import initialize_distributed, make_mesh
+from ddlpc_tpu.parallel.shard_update import StateLayout, resolve_shard_update
 from ddlpc_tpu.parallel.train_step import (
     create_train_state,
     make_eval_step,
@@ -126,6 +126,16 @@ class Trainer:
         self.model = build_model_from_experiment(cfg)
         self.spatial = cfg.parallel.space_axis_size > 1
         space = cfg.parallel.space_axis_name if self.spatial else None
+        # ZeRO-1 sharded optimizer update (parallel/shard_update.py,
+        # docs/SHARDING.md): 'auto' resolves on for data meshes > 1 unless
+        # a codec combination cannot compose; explicit 'on' raises there.
+        self.shard_update = resolve_shard_update(
+            cfg.parallel.shard_update,
+            cfg.compression,
+            data_size,
+            self.spatial,
+            grad_clip_norm=cfg.train.grad_clip_norm,
+        )
 
         # Created before the loader so the ShardedLoader can thread its
         # per-stage host timings (loader_gather/cast/upload) into the same
@@ -170,7 +180,24 @@ class Trainer:
             jax.random.key(cfg.train.seed),
             (1, h, w, channels),
         )
-        self.state = jax.device_put(self.state, NamedSharding(self.mesh, P()))
+        # Run layout: replicated, or — under the sharded update — the Adam
+        # moments chunked (shard_map path) / partitioned (GSPMD path) over
+        # the data axis, 1/N per device.  ``layout`` converts both ways;
+        # checkpoints and multi-host broadcasts always move the canonical
+        # (gathered) layout, so on-disk state is layout-independent.
+        layout_mode = (
+            ("gspmd" if self.spatial else "zero1")
+            if self.shard_update
+            else "replicated"
+        )
+        self.layout = StateLayout(
+            layout_mode,
+            self.tx,
+            self.state,
+            self.mesh,
+            cfg.parallel.data_axis_name,
+        )
+        self.state = self.layout.place(self.state)
 
         # Pure data mesh → hand-written shard_map collectives (reference-
         # parity codec semantics); data×space mesh → GSPMD, where XLA
@@ -231,6 +258,7 @@ class Trainer:
                 space_axis=cfg.parallel.space_axis_name,
                 remat=cfg.train.remat,
                 seed=cfg.train.seed,
+                shard_update=self.shard_update,
             )
         return make_train_step(
             self.model,
@@ -240,6 +268,7 @@ class Trainer:
             data_axis=cfg.parallel.data_axis_name,
             remat=cfg.train.remat,
             seed=cfg.train.seed,
+            shard_update=self.shard_update,
         )
 
     def _restore_synchronized(self) -> None:
@@ -253,10 +282,12 @@ class Trainer:
         """
         if jax.process_count() == 1:
             if ckpt.latest_step(self.ckpt_dir) is not None:
-                self.state, meta = ckpt.restore_checkpoint(self.ckpt_dir, self.state)
-                self.state = jax.device_put(
-                    self.state, NamedSharding(self.mesh, P())
-                )
+                # The restore target only supplies pytree STRUCTURE (leaf
+                # shapes come from the blob) — checkpoints store the
+                # canonical gathered layout regardless of the run layout,
+                # and place() re-chunks/re-shards for this run.
+                state, meta = ckpt.restore_checkpoint(self.ckpt_dir, self.state)
+                self.state = self.layout.place(state)
                 self.start_epoch = int(meta.get("epoch", -1)) + 1
             return
         from jax.experimental import multihost_utils
@@ -265,7 +296,7 @@ class Trainer:
             state, meta = ckpt.restore_checkpoint(self.ckpt_dir, self.state)
             found, epoch_next = 1, int(meta.get("epoch", -1)) + 1
         else:
-            state, found, epoch_next = self.state, 0, 0
+            state, found, epoch_next = None, 0, 0
         # Separate found flag: a checkpoint with missing/epoch-less metadata
         # must still restore its weights (resuming at epoch 0), matching the
         # single-process branch.
@@ -276,8 +307,17 @@ class Trainer:
             )
         )
         if found:
-            state = multihost_utils.broadcast_one_to_all(state)
-            self.state = jax.device_put(state, NamedSharding(self.mesh, P()))
+            # The broadcast moves the CANONICAL layout (every process must
+            # contribute a structurally identical pytree; under a sharded
+            # run layout the local state's chunk shapes would not match the
+            # full-layout restore).  canonical() is a compiled collective,
+            # so EVERY process runs it — process 0 included, discarding the
+            # result in favor of the restored state.
+            template = self.layout.canonical(self.state)
+            state = multihost_utils.broadcast_one_to_all(
+                state if state is not None else template
+            )
+            self.state = self.layout.place(state)
             self.start_epoch = epoch_next
 
     # ------------------------------------------------------------------
@@ -363,6 +403,10 @@ class Trainer:
         # running uint32 would wrap past 2^32 pixels on Cityscapes-scale
         # splits; float64 is unavailable without jax x64).
         per_batch = []
+        # Strip the optimizer state from the eval input: the eval steps pin
+        # the state replicated, and resharding sharded Adam moments into an
+        # unused argument would all-gather them once per eval batch.
+        eval_state = self.state.replace(opt_state=())
         for images, labels in eval_batches(
             self.test_ds,
             self.mesh,
@@ -371,7 +415,7 @@ class Trainer:
             space_axis=self.cfg.parallel.space_axis_name if self.spatial else None,
         ):
             self.watchdog.beat("eval")
-            out = self.eval_step(self.state, images, labels)
+            out = self.eval_step(eval_state, images, labels)
             per_batch.append(
                 (out["confusion"], out["loss_sum"], out["pixel_count"])
             )
@@ -415,9 +459,16 @@ class Trainer:
         )
 
     def save(self, epoch: int) -> None:
+        # Checkpoints store the canonical gathered layout — under a sharded
+        # run layout this all-gathers the moments ONCE per save (a
+        # transient; the steady state never holds them replicated), and the
+        # on-disk blob restores bit-identically into either layout.  The
+        # gather is a collective: every process runs it, then only process
+        # 0 snapshots/writes (AsyncCheckpointer's gate).
+        state = self.layout.canonical(self.state)
         self.checkpointer.save(
             self.ckpt_dir,
-            self.state,
+            state,
             step=int(jax.device_get(self.state.step)),
             metadata={
                 "epoch": epoch,

@@ -33,6 +33,14 @@ def main() -> None:
     p.add_argument("--tiles", type=int, default=1024)
     p.add_argument("--config", default="configs/vaihingen_unet_tpu_flagship.json")
     p.add_argument("--bench-tiles-per-s", type=float, default=1685.0)
+    p.add_argument(
+        "--shard-update",
+        choices=("auto", "on", "off"),
+        default="auto",
+        help="ZeRO-1 sharded optimizer update (docs/SHARDING.md); the "
+        "report records the resolved value and the isolated "
+        "update_ms_per_step for the arm",
+    )
     p.add_argument("--workdir", default="runs/trainer_loop_bench")
     p.add_argument("--out", default="docs/flagship_recipe/trainer_loop.json")
     args = p.parse_args()
@@ -58,9 +66,29 @@ def main() -> None:
             checkpoint_every_epochs=0,
             eval_every_epochs=args.epochs,  # once, at the end
         ),
+        parallel=dataclasses.replace(
+            cfg.parallel, shard_update=args.shard_update
+        ),
         workdir=args.workdir,
     )
     trainer = Trainer(cfg, resume=False)
+    # Per-step update-path breakdown (same program family the fused step
+    # embeds, timed in isolation — bench.py's update-only microbench).
+    # Only the pure data mesh: make_update_step speaks the zero1 chunk
+    # layout, not the GSPMD param-shaped one a spatial trainer places
+    # (measure_update_ms requires the state in its matching run layout).
+    update_ms = None
+    if not trainer.spatial:
+        from bench import measure_update_ms
+
+        update_ms = measure_update_ms(
+            trainer.tx,
+            trainer.mesh,
+            cfg.compression,
+            trainer.state,
+            trainer.shard_update,
+            rounds=2,
+        )
     trainer.fit()
 
     records = [
@@ -78,6 +106,10 @@ def main() -> None:
         "bench_tiles_per_s": args.bench_tiles_per_s,
         "ratio_vs_bench": round(sustained / args.bench_tiles_per_s, 3),
         "wrap_fill_factor": records[-1].get("wrap_fill_factor"),
+        "shard_update": bool(trainer.shard_update),
+        "update_ms_per_step": (
+            round(update_ms, 3) if update_ms is not None else None
+        ),
     }
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out, "w") as f:
