@@ -239,6 +239,26 @@ class TrainConfig:
     # first-compile + slowest-step bound; 0 disables.
     stall_timeout_s: float = 0.0
     stall_action: str = "dump"  # dump | abort
+    # Unified telemetry (ddlpc_tpu/obs, docs/OBSERVABILITY.md).
+    # trace=True arms the span tracer: per-phase spans (data wait, step
+    # dispatch, loader gather/cast/upload, checkpoint, eval) stream to
+    # <workdir>/spans.jsonl and a Perfetto-loadable <workdir>/trace.json.
+    # Off (the default) the tracer is a no-op costing one attribute test
+    # per would-be span.
+    trace: bool = False
+    # While tracing, block_until_ready on the step output every K steps so
+    # spans measure REAL step latency at a sampled cadence without draining
+    # the async dispatch pipeline on every step.  0 = never sync.
+    trace_sync_every_steps: int = 16
+    # >= 0 starts a stdlib HTTP telemetry endpoint on this port (0 =
+    # ephemeral, for tests): GET /metrics (Prometheus text or JSON by
+    # Accept header), /healthz (+ recent health alerts), /debug/trace
+    # (arms the on-demand profiler).  -1 = off.  Process 0 only.
+    telemetry_port: int = -1
+    # Steps per on-demand profiler capture (SIGUSR2 or /debug/trace
+    # without an explicit ?steps=N); the capture ends with a device sync
+    # and aggregates into <workdir>/top_ops_NNN.json (obs/profiling.py).
+    profile_steps: int = 20
 
 
 @dataclass(frozen=True)
@@ -351,6 +371,12 @@ class ServeConfig:
     overlap: float = 0.25  # sliding-window overlap for full scenes
     metrics_window: int = 2048  # latency ring size for p50/p95/p99
     metrics_every_s: float = 10.0  # periodic JSONL snapshot cadence; 0 = off
+    # Span tracer for the request path (enqueue → coalesce → jit execute →
+    # stitch): spans stream to <workdir>/serve_spans.jsonl and a Perfetto
+    # trace to <workdir>/serve_trace.json (docs/OBSERVABILITY.md).
+    trace: bool = False
+    # Default batched forwards per /debug/trace profiler capture.
+    profile_steps: int = 8
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)

@@ -1,0 +1,60 @@
+"""Unified telemetry for the whole stack (docs/OBSERVABILITY.md).
+
+One subsystem, four capabilities, shared by training and serving:
+
+- :mod:`tracing` — span-based tracer (``Span``/``Tracer``) with JSONL and
+  Chrome-trace-event exporters, near-zero overhead when disabled;
+- :mod:`registry` — Prometheus-style Counter/Gauge/Histogram registry with
+  text exposition, published into by both ``MetricsLogger`` (train) and
+  ``ServeMetrics`` (serve);
+- :mod:`profiling` + :mod:`xplane` — on-demand ``jax.profiler`` capture
+  (SIGUSR2 in the Trainer, ``/debug/trace`` in serve) aggregated through
+  the xplane self-time logic into a committed-format top-ops report;
+- :mod:`health` — EWMA step-time regression, loss NaN/spike and serve
+  queue-saturation detectors emitting structured alert records into the
+  metrics stream and the ``StallWatchdog``'s diagnosis.
+
+Everything except :mod:`profiling`/:mod:`xplane` is pure stdlib — no jax
+import at module scope — so the tracer and registry are importable (and
+testable) anywhere, including the serve path's worker threads.
+
+``SCHEMA_VERSION`` stamps every JSONL metrics/span/alert record; the
+``scripts/check_metrics_schema.py`` lint (invoked from tier-1) keeps the
+"tooling tails any stream unchanged" contract honest.
+"""
+
+from __future__ import annotations
+
+from ddlpc_tpu.obs.schema import SCHEMA_VERSION, check_record  # noqa: E402
+
+from ddlpc_tpu.obs.health import (  # noqa: E402
+    Alert,
+    EwmaRegressionDetector,
+    HealthMonitor,
+    LossDetector,
+    QueueSaturationDetector,
+)
+from ddlpc_tpu.obs.registry import (  # noqa: E402
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from ddlpc_tpu.obs.tracing import NULL_SPAN, Span, Tracer  # noqa: E402
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "Alert",
+    "Counter",
+    "EwmaRegressionDetector",
+    "Gauge",
+    "HealthMonitor",
+    "Histogram",
+    "LossDetector",
+    "MetricsRegistry",
+    "NULL_SPAN",
+    "QueueSaturationDetector",
+    "Span",
+    "Tracer",
+    "check_record",
+]

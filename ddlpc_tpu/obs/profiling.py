@@ -1,0 +1,254 @@
+"""On-demand ``jax.profiler`` capture → committed-format top-ops report.
+
+Two live triggers share this module (plus the offline scripts via
+obs/xplane.py):
+
+- the Trainer installs SIGUSR2 → :meth:`OnDemandProfiler.arm`, and its
+  step loop drives :meth:`OnDemandProfiler.step_done` — the capture spans
+  exactly N dispatched steps, ends with a device sync so the async
+  pipeline's queued work is actually in the trace, and the aggregated
+  report lands next to the run's metrics;
+- the serve frontend's ``/debug/trace?steps=N`` route uses
+  :func:`capture` directly around its forward counter.
+
+Failure discipline: profiling is diagnostics, never the run's critical
+path.  A backend that cannot trace, a second concurrent capture, or a
+missing xplane proto all degrade to an ``error`` field in the returned
+report — they never raise into the training loop or the request handler.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from ddlpc_tpu.obs import xplane as _xplane
+
+# One capture at a time per process: jax.profiler supports a single active
+# trace, and both the trainer trigger and the serve endpoint may live in
+# one process (tests do exactly that).
+_capture_lock = threading.Lock()
+
+
+class CaptureBusy(RuntimeError):
+    """Another profiler capture is already running in this process."""
+
+
+def aggregate(trace_dir: str, steps: int, top: int = 30, tag: str = "") -> dict:
+    """Top-ops report for a finished trace; xplane unavailability becomes
+    a report-level ``error`` (the raw trace stays on disk either way)."""
+    try:
+        return _xplane.top_ops_report(trace_dir, top=top, steps=steps, tag=tag)
+    except Exception as e:
+        # Not just XplaneUnavailable/FileNotFoundError: a truncated .pb
+        # (protobuf DecodeError) or any parser surprise must also degrade
+        # — this function runs on the training thread via step_done().
+        return {
+            "tag": tag,
+            "trace_dir": os.path.abspath(trace_dir),
+            "steps_traced": steps,
+            "error": f"{type(e).__name__}: {e}",
+        }
+
+
+def capture(
+    trace_dir: str,
+    until: Callable[[], bool],
+    timeout_s: float = 30.0,
+    poll_s: float = 0.01,
+) -> dict:
+    """Run one profiler capture until ``until()`` (or timeout); returns
+    ``{"trace_dir", "seconds", "timed_out"}`` or ``{"error"}``.  Raises
+    :class:`CaptureBusy` when a capture is already active."""
+    import jax
+
+    if not _capture_lock.acquire(blocking=False):
+        raise CaptureBusy("a profiler capture is already running")
+    try:
+        t0 = time.perf_counter()
+        try:
+            jax.profiler.start_trace(trace_dir)
+        except Exception as e:  # backend without profiler support
+            return {"error": f"profiler failed to start: {e}"}
+        timed_out = False
+        try:
+            deadline = t0 + timeout_s
+            while not until():
+                if time.perf_counter() >= deadline:
+                    timed_out = True
+                    break
+                time.sleep(poll_s)
+        finally:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:
+                return {"error": f"profiler failed to stop: {e}"}
+        return {
+            "trace_dir": trace_dir,
+            "seconds": round(time.perf_counter() - t0, 4),
+            "timed_out": timed_out,
+        }
+    finally:
+        _capture_lock.release()
+
+
+class OnDemandProfiler:
+    """Arm-from-anywhere, capture-in-the-loop profiling for the Trainer.
+
+    ``arm()`` is async-signal-safe-enough for a Python signal handler (it
+    sets an Event).  The training loop calls ``step_done(sync)`` once per
+    dispatched step; the profiler starts a trace on the first armed step,
+    counts ``steps`` more dispatches, calls ``sync()`` (block_until_ready
+    on that step's output — the async dispatch queue must drain INTO the
+    trace), stops, aggregates, and writes ``top_ops_<n>.json`` +
+    ``profile_<n>/`` under ``out_dir``.
+    """
+
+    def __init__(
+        self,
+        out_dir: str,
+        steps: int = 20,
+        top: int = 30,
+        logger=None,
+        enabled: bool = True,
+    ):
+        self.out_dir = out_dir
+        self.steps = max(int(steps), 1)
+        self.top = top
+        self.logger = logger
+        self.enabled = enabled
+        self._armed = threading.Event()
+        self._active = False
+        self._steps_left = 0
+        self._capture_n = 0
+        self._trace_dir: Optional[str] = None
+        self._t0 = 0.0
+        self.last_report: Optional[dict] = None
+
+    def arm(self, steps: Optional[int] = None) -> None:
+        """Request a capture of the next ``steps`` training steps (callable
+        from a signal handler or another thread)."""
+        if steps is not None:
+            self.steps = max(int(steps), 1)
+        self._armed.set()
+
+    @property
+    def armed(self) -> bool:
+        return self._armed.is_set() or self._active
+
+    def step_done(self, sync: Optional[Callable[[], None]] = None) -> Optional[dict]:
+        """Drive the capture state machine; call once per dispatched step.
+        Returns the report dict when a capture completes, else None."""
+        if not self.enabled:
+            return None
+        if self._active:
+            self._steps_left -= 1
+            if self._steps_left > 0:
+                return None
+            return self._finish(sync)
+        if not self._armed.is_set():
+            return None
+        self._armed.clear()
+        return self._start()
+
+    def finalize(self, sync: Optional[Callable[[], None]] = None) -> Optional[dict]:
+        """Close out a capture the run ended mid-way through (fewer steps
+        ran than were requested): stop the trace and aggregate over the
+        steps that actually happened, so the run never exits with the
+        profiler left open and the arm silently lost."""
+        if not self._active:
+            return None
+        requested = self.steps
+        self.steps = max(self.steps - self._steps_left, 1)
+        try:
+            return self._finish(sync)
+        finally:
+            self.steps = requested
+
+    # -- internals ---------------------------------------------------------
+
+    def _start(self) -> None:
+        import jax
+
+        if not _capture_lock.acquire(blocking=False):
+            self.last_report = {"error": "a profiler capture is already running"}
+            return None
+        self._capture_n += 1
+        self._trace_dir = os.path.join(
+            self.out_dir, f"profile_{self._capture_n:03d}"
+        )
+        os.makedirs(self._trace_dir, exist_ok=True)
+        try:
+            jax.profiler.start_trace(self._trace_dir)
+        except Exception as e:
+            _capture_lock.release()
+            self.last_report = {"error": f"profiler failed to start: {e}"}
+            return None
+        self._active = True
+        self._steps_left = self.steps
+        self._t0 = time.perf_counter()
+        return None
+
+    def _finish(self, sync: Optional[Callable[[], None]]) -> dict:
+        import jax
+
+        try:
+            sync_error = None
+            if sync is not None:
+                # Drain the dispatch queue into the trace: without this the
+                # last steps' device work may execute after stop_trace.
+                try:
+                    sync()
+                except Exception as e:
+                    # A failed step must not leave the profiler running (the
+                    # next capture would deadlock on a trace that never
+                    # stops) — record the error and still stop the trace.
+                    sync_error = f"sync failed: {e}"
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:
+                self.last_report = {"error": f"profiler failed to stop: {e}"}
+                return self.last_report
+        finally:
+            self._active = False
+            _capture_lock.release()
+        wall = time.perf_counter() - self._t0
+        report = aggregate(
+            self._trace_dir,
+            steps=self.steps,
+            top=self.top,
+            tag=f"ondemand_{self._capture_n:03d}",
+        )
+        report["wall_s"] = round(wall, 4)
+        report["wall_ms_per_step"] = round(wall * 1e3 / self.steps, 3)
+        if sync_error is not None:
+            report.setdefault("error", sync_error)
+        path = os.path.join(
+            self.out_dir, f"top_ops_{self._capture_n:03d}.json"
+        )
+        try:
+            with open(path, "w") as f:
+                json.dump(report, f, indent=2)
+            report["report_path"] = path
+        except OSError as e:  # full disk must not kill the training loop
+            report.setdefault("error", f"report not written: {e}")
+        self.last_report = report
+        if self.logger is not None:
+            try:
+                self.logger.log(
+                    {
+                        "kind": "profile",
+                        "report_path": report.get("report_path"),
+                        "steps_traced": self.steps,
+                        "per_step_ms": report.get("per_step_ms"),
+                        "wall_ms_per_step": report["wall_ms_per_step"],
+                        "error": report.get("error"),
+                    },
+                    echo=False,
+                )
+            except Exception:
+                pass  # diagnostics must not break the observed loop
+        return report

@@ -1,0 +1,47 @@
+"""The one-flat-JSON-object-per-line record contract, in code.
+
+Every JSONL stream in the repo — ``metrics.jsonl``, ``serve_metrics.jsonl``,
+``spans.jsonl``, ``serve_spans.jsonl`` — carries records of this shape, so
+one tool (``scripts/obs_tail.py``) tails any of them and one lint
+(``scripts/check_metrics_schema.py``, invoked from tier-1) keeps emitters
+honest.  :func:`check_record` is the single owner of what "flat" means.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+# Version of the flat-JSONL record schema.  Bump ONLY on a breaking shape
+# change (a record stops being one flat JSON object per line); adding keys
+# is not a bump.
+SCHEMA_VERSION = 1
+
+_SCALAR = (str, int, float, bool, type(None))
+
+
+def check_record(obj: object) -> List[str]:
+    """Violations of the stream contract for one decoded JSONL record.
+
+    A conforming record is a JSON object whose values are scalars or lists
+    of scalars (``val_iou_per_class`` is a list), carrying an integer
+    ``schema`` field.  Returns human-readable violation strings; empty
+    means conforming.
+    """
+    errs: List[str] = []
+    if not isinstance(obj, dict):
+        return [f"record is {type(obj).__name__}, not a JSON object"]
+    schema = obj.get("schema")
+    if schema is None:
+        errs.append("missing 'schema' field")
+    elif not isinstance(schema, int) or isinstance(schema, bool):
+        errs.append(f"'schema' must be an integer, got {schema!r}")
+    for k, v in obj.items():
+        if isinstance(v, _SCALAR):
+            continue
+        if isinstance(v, list) and all(isinstance(x, _SCALAR) for x in v):
+            continue
+        errs.append(
+            f"key {k!r} holds a {type(v).__name__} — records must stay flat "
+            f"(scalars or lists of scalars)"
+        )
+    return errs

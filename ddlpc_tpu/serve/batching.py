@@ -27,7 +27,10 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future
+from contextlib import nullcontext
 from typing import Callable, List, Optional, Sequence
+
+_NULL_CTX = nullcontext()
 
 
 class Overloaded(RuntimeError):
@@ -43,13 +46,18 @@ class EngineClosed(RuntimeError):
 
 
 class _Item:
-    __slots__ = ("payload", "future", "enqueued", "deadline")
+    __slots__ = ("payload", "future", "enqueued", "deadline", "t_trace")
 
-    def __init__(self, payload, deadline: Optional[float], now: float):
+    def __init__(
+        self, payload, deadline: Optional[float], now: float, t_trace: float = 0.0
+    ):
         self.payload = payload
         self.future: Future = Future()
         self.enqueued = now
         self.deadline = deadline
+        # Enqueue time on the tracer's clock (tracing enabled only): the
+        # worker records the cross-thread enqueue→batch-take wait with it.
+        self.t_trace = t_trace
 
 
 def _fail(future: Future, exc: Exception) -> None:
@@ -81,6 +89,7 @@ class MicroBatcher:
         max_wait_ms: float = 5.0,
         queue_limit: int = 64,
         metrics=None,
+        tracer=None,
         start: bool = True,
     ):
         if max_batch < 1:
@@ -92,6 +101,10 @@ class MicroBatcher:
         self.max_wait_s = float(max_wait_ms) / 1000.0
         self.queue_limit = int(queue_limit)
         self.metrics = metrics
+        # Optional span tracer (obs/tracing.py): the worker records one
+        # cross-thread ``batch_coalesce`` span per batch (oldest member's
+        # enqueue → batch take) and a ``jit_execute`` span around forward.
+        self.tracer = tracer
         self._q: deque[_Item] = deque()
         self._cond = threading.Condition()
         self._closing = False
@@ -141,7 +154,12 @@ class MicroBatcher:
                     f"queue full ({len(self._q)}/{self.queue_limit} + "
                     f"{len(payloads)} new); retry with backoff"
                 )
-            items = [_Item(p, deadline, now) for p in payloads]
+            t_trace = (
+                self.tracer.now()
+                if self.tracer is not None and self.tracer.enabled
+                else 0.0
+            )
+            items = [_Item(p, deadline, now, t_trace) for p in payloads]
             self._q.extend(items)
             if self.metrics is not None:
                 self.metrics.set_queue_depth(len(self._q))
@@ -205,8 +223,24 @@ class MicroBatcher:
             if not live:
                 continue
             self.forward_count += 1
+            tracer = self.tracer
+            if tracer is not None and tracer.enabled:
+                # Cross-thread coalesce wait: the oldest live member's
+                # enqueue (client thread) → this batch take (worker).
+                tracer.add_span(
+                    "batch_coalesce",
+                    live[0].t_trace,
+                    tracer.now(),
+                    batch=len(live),
+                )
+            span = (
+                tracer.span("jit_execute", batch=len(live))
+                if tracer is not None
+                else _NULL_CTX
+            )
             try:
-                results = list(self._forward([it.payload for it in live]))
+                with span:
+                    results = list(self._forward([it.payload for it in live]))
                 if len(results) != len(live):
                     # A short/long result list would otherwise leave some
                     # futures unresolved FOREVER — turn the contract breach

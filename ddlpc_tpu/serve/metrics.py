@@ -2,10 +2,16 @@
 
 Rides the same JSONL stream shape as training (`train/observability.py`
 ``MetricsLogger``): one flat JSON object per emit, so the tooling that tails
-training metrics tails serving metrics unchanged.  Quantiles come from a
-bounded ring of recent request latencies (windowed, not lifetime, so a load
-spike is visible in p99 and then ages out); rates (requests/sec, tiles/sec)
-are measured over the interval since the previous snapshot.
+training metrics tails serving metrics unchanged.  Quantiles AND batch
+occupancy come from bounded rings of recent observations (windowed, not
+lifetime, so a load spike is visible in p99 — and a cold-start occupancy
+ramp ages out instead of dragging the reported mean forever); rates
+(requests/sec, tiles/sec) are measured over the interval since the previous
+snapshot.
+
+With a ``registry`` (obs/registry.py) every hook also updates the
+Prometheus-side series (``ddlpc_serve_*``), so the text exposition on
+``GET /metrics`` reflects live counters without a snapshot cycle.
 """
 
 from __future__ import annotations
@@ -28,20 +34,55 @@ class ServeMetrics:
     queue depth, sheds, deadline misses).
     """
 
-    def __init__(self, window: int = 2048):
+    def __init__(self, window: int = 2048, registry=None):
         self._lock = threading.Lock()
         self._lat = deque(maxlen=window)  # seconds, most-recent window
+        # Windowed like the latency ring: a day-old cold-start ramp must
+        # not drag the reported occupancy permanently (the old lifetime
+        # `_occupancy_sum` did exactly that).
+        self._occ = deque(maxlen=window)
         self.requests = 0
         self.tiles = 0
         self.shed = 0
         self.deadline_exceeded = 0
         self.batches = 0
-        self._occupancy_sum = 0.0
         self.queue_depth = 0
         self._t0 = time.monotonic()
         self._last_t = self._t0
         self._last_requests = 0
         self._last_tiles = 0
+        # Prometheus-side series (optional; obs/registry.py).
+        self._reg = None
+        if registry is not None:
+            self._reg = {
+                "requests": registry.counter(
+                    "ddlpc_serve_requests_total", "Scene requests completed."
+                ),
+                "tiles": registry.counter(
+                    "ddlpc_serve_tiles_total", "Tiles forwarded for requests."
+                ),
+                "latency": registry.histogram(
+                    "ddlpc_serve_request_latency_seconds",
+                    "End-to-end scene request latency.",
+                ),
+                "shed": registry.counter(
+                    "ddlpc_serve_shed_total", "Requests shed at admission."
+                ),
+                "deadline": registry.counter(
+                    "ddlpc_serve_deadline_exceeded_total",
+                    "Requests expired in queue past their deadline.",
+                ),
+                "batches": registry.counter(
+                    "ddlpc_serve_batches_total", "Batched forwards executed."
+                ),
+                "occupancy": registry.gauge(
+                    "ddlpc_serve_batch_occupancy",
+                    "Occupancy (size/capacity) of the most recent batch.",
+                ),
+                "queue_depth": registry.gauge(
+                    "ddlpc_serve_queue_depth", "Admission queue depth (tiles)."
+                ),
+            }
 
     # ---- recording hooks ---------------------------------------------------
 
@@ -50,23 +91,37 @@ class ServeMetrics:
             self._lat.append(float(latency_s))
             self.requests += 1
             self.tiles += int(tiles)
+        if self._reg is not None:
+            self._reg["requests"].inc()
+            self._reg["tiles"].inc(int(tiles))
+            self._reg["latency"].observe(float(latency_s))
 
     def record_batch(self, size: int, capacity: int) -> None:
+        occ = size / max(capacity, 1)
         with self._lock:
             self.batches += 1
-            self._occupancy_sum += size / max(capacity, 1)
+            self._occ.append(occ)
+        if self._reg is not None:
+            self._reg["batches"].inc()
+            self._reg["occupancy"].set(occ)
 
     def record_shed(self, n: int = 1) -> None:
         with self._lock:
             self.shed += int(n)
+        if self._reg is not None:
+            self._reg["shed"].inc(int(n))
 
     def record_deadline(self, n: int = 1) -> None:
         with self._lock:
             self.deadline_exceeded += int(n)
+        if self._reg is not None:
+            self._reg["deadline"].inc(int(n))
 
     def set_queue_depth(self, depth: int) -> None:
         with self._lock:
             self.queue_depth = int(depth)
+        if self._reg is not None:
+            self._reg["queue_depth"].set(int(depth))
 
     # ---- readout -----------------------------------------------------------
 
@@ -101,7 +156,7 @@ class ServeMetrics:
                 self._last_requests = self.requests
                 self._last_tiles = self.tiles
             occupancy = (
-                self._occupancy_sum / self.batches if self.batches else None
+                float(np.mean(self._occ)) if self._occ else None
             )
             return {
                 "kind": "serve",

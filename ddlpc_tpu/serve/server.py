@@ -23,6 +23,7 @@ import argparse
 import concurrent.futures
 import io
 import json
+import os
 import signal
 import sys
 import threading
@@ -34,6 +35,11 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from ddlpc_tpu.config import ServeConfig
+from ddlpc_tpu.obs import profiling as _profiling
+from ddlpc_tpu.obs.health import HealthMonitor
+from ddlpc_tpu.obs.http import render_metrics
+from ddlpc_tpu.obs.registry import MetricsRegistry
+from ddlpc_tpu.obs.tracing import Tracer
 from ddlpc_tpu.serve.batching import (
     DeadlineExceeded,
     EngineClosed,
@@ -59,16 +65,41 @@ class ServingFrontend:
     ):
         self.engine = engine
         self.cfg = cfg or ServeConfig()
-        self.metrics = ServeMetrics(window=self.cfg.metrics_window)
+        # Unified telemetry (ddlpc_tpu/obs): a Prometheus-style registry
+        # every metrics hook publishes into (GET /metrics negotiates text
+        # exposition vs the legacy JSON snapshot), a span tracer for the
+        # request path, and health detectors for queue saturation.
+        self.registry = MetricsRegistry()
+        self.tracer = Tracer(
+            enabled=self.cfg.trace,
+            service="serve",
+            jsonl_path=os.path.join(self.cfg.workdir, "serve_spans.jsonl"),
+            chrome_path=os.path.join(self.cfg.workdir, "serve_trace.json"),
+        )
+        self.metrics = ServeMetrics(
+            window=self.cfg.metrics_window, registry=self.registry
+        )
         self.batcher = MicroBatcher(
             engine.forward_windows,
             max_batch=self.cfg.max_batch,
             max_wait_ms=self.cfg.max_wait_ms,
             queue_limit=self.cfg.queue_limit,
             metrics=self.metrics,
+            tracer=self.tracer,
         )
         self.logger = logger
+        if logger is not None and getattr(logger, "registry", None) is None:
+            # The serve CLI builds its logger before this frontend (and its
+            # registry) exists — wire it here so the periodic snapshot
+            # records (p50/p95/p99 quantiles) reach the Prometheus
+            # exposition as ddlpc_serve_* gauges too.
+            logger.attach_registry(self.registry)
+        self.health = HealthMonitor(
+            logger=logger, registry=self.registry, service="serve"
+        )
         self.draining = False
+        self._profile_lock = threading.Lock()
+        self._profile_n = 0
         self._emit_stop = threading.Event()
         self._emitter: Optional[threading.Thread] = None
         if logger is not None and self.cfg.metrics_every_s > 0:
@@ -80,6 +111,12 @@ class ServingFrontend:
     def _emit_loop(self) -> None:
         while not self._emit_stop.wait(self.cfg.metrics_every_s):
             self.metrics.emit(self.logger)
+            # Queue-saturation detection rides the emit cadence: a single
+            # full sample is a burst, N consecutive saturated samples at
+            # this cadence mean shedding is imminent (obs/health.py).
+            self.health.observe_queue(
+                self.batcher.queue_depth, self.cfg.queue_limit
+            )
 
     # ---- request paths -----------------------------------------------------
 
@@ -99,7 +136,21 @@ class ServingFrontend:
         overlap = self.cfg.overlap if overlap is None else overlap
         th, tw = self.engine.tile
         t0 = time.monotonic()
-        padded, origins, (h, w) = window_plan(image, self.engine.tile, overlap)
+        # Root span per scene request; window_plan/enqueue/stitch nest
+        # under it on this thread (the batcher's coalesce/execute spans are
+        # cross-thread and stand alone on the worker's track).
+        with self.tracer.span("serve_request") as req_span:
+            out, n_tiles = self._predict_logits_inner(
+                image, overlap, th, tw, req_span
+            )
+        self.metrics.record_request(time.monotonic() - t0, tiles=n_tiles)
+        return out
+
+    def _predict_logits_inner(self, image, overlap, th, tw, req_span):
+        with self.tracer.span("window_plan"):
+            padded, origins, (h, w) = window_plan(
+                image, self.engine.tile, overlap
+            )
         # Chunked admission: each chunk is admitted all-or-nothing (a shed
         # chunk never half-occupies the queue), but a scene that tiles into
         # more windows than the queue holds is NOT permanently rejected —
@@ -118,12 +169,14 @@ class ServingFrontend:
         for i in range(0, len(origins), chunk_size):
             chunk = origins[i : i + chunk_size]
             windows = [padded[y : y + th, x : x + tw] for y, x in chunk]
-            futures = self.batcher.submit_many(
-                windows, deadline_ms=self.cfg.deadline_ms or None
-            )
+            with self.tracer.span("enqueue", windows=len(windows)):
+                futures = self.batcher.submit_many(
+                    windows, deadline_ms=self.cfg.deadline_ms or None
+                )
             try:
-                for origin, fut in zip(chunk, futures):
-                    st.add(origin, fut.result(timeout=timeout))
+                with self.tracer.span("stitch", windows=len(windows)):
+                    for origin, fut in zip(chunk, futures):
+                        st.add(origin, fut.result(timeout=timeout))
             except BaseException:
                 # The scene already failed: cancel still-queued sibling
                 # windows so the batcher stops burning capacity on a
@@ -132,10 +185,8 @@ class ServingFrontend:
                     fut.cancel()
                 raise
         out = st.finish()
-        self.metrics.record_request(
-            time.monotonic() - t0, tiles=len(origins)
-        )
-        return out
+        req_span.set(tiles=len(origins))
+        return out, len(origins)
 
     def predict_classes(
         self, image: np.ndarray, overlap: Optional[float] = None
@@ -168,7 +219,60 @@ class ServingFrontend:
             "channels": self.engine.channels,
             "queue_depth": self.batcher.queue_depth,
             "compiled_shapes": self.engine.compiled_shapes,
+            "alerts": list(self.health.alerts),
         }
+
+    def debug_trace(self, steps: Optional[int] = None, timeout_s: float = 30.0) -> dict:
+        """On-demand profiler capture over the next ``steps`` batched
+        forwards: jax.profiler trace → xplane self-time aggregation → the
+        committed top-ops format, written as ``serve_top_ops_<n>.json`` in
+        the workdir.  Returns the report (an ``error`` field instead of an
+        exception for every failure mode — a second concurrent capture, a
+        backend that cannot trace, no traffic within the timeout)."""
+        steps = int(steps) if steps else self.cfg.profile_steps
+        with self._profile_lock:
+            self._profile_n += 1
+            n = self._profile_n
+        trace_dir = os.path.join(self.cfg.workdir, f"serve_profile_{n:03d}")
+        target = self.batcher.forward_count + steps
+        try:
+            res = _profiling.capture(
+                trace_dir,
+                until=lambda: self.batcher.forward_count >= target,
+                timeout_s=timeout_s,
+            )
+        except _profiling.CaptureBusy as e:
+            return {"error": str(e)}
+        if "error" in res:
+            return res
+        captured = steps if not res.get("timed_out") else max(
+            self.batcher.forward_count - (target - steps), 1
+        )
+        report = _profiling.aggregate(
+            trace_dir, steps=captured, tag=f"serve_ondemand_{n:03d}"
+        )
+        report["timed_out"] = res.get("timed_out", False)
+        report["wall_s"] = res.get("seconds")
+        path = os.path.join(self.cfg.workdir, f"serve_top_ops_{n:03d}.json")
+        try:
+            os.makedirs(self.cfg.workdir, exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(report, f, indent=2)
+            report["report_path"] = path
+        except OSError as e:
+            report.setdefault("error", f"report not written: {e}")
+        if self.logger is not None:
+            self.logger.log(
+                {
+                    "kind": "profile",
+                    "report_path": report.get("report_path"),
+                    "steps_traced": captured,
+                    "per_step_ms": report.get("per_step_ms"),
+                    "error": report.get("error"),
+                },
+                echo=False,
+            )
+        return report
 
     def close(self, drain: bool = True) -> None:
         """Stop admission, finish queued work (drain=True), stop emitting."""
@@ -179,6 +283,9 @@ class ServingFrontend:
             self._emitter.join(timeout=5.0)
         if self.logger is not None:
             self.metrics.emit(self.logger)
+        # Traced deploys drop serve_trace.json on shutdown (flush-and-close
+        # is a no-op for a disabled tracer).
+        self.tracer.close()
 
 
 # ---- HTTP layer -------------------------------------------------------------
@@ -224,16 +331,46 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self) -> None:
-        path = urlparse(self.path).path
+        parsed = urlparse(self.path)
+        path = parsed.path
         if path == "/healthz":
             h = self.frontend.healthz()
             self._send_json(200 if h["status"] == "ok" else 503, h)
         elif path == "/metrics":
-            # advance=False: a scrape must not reset the rate interval the
-            # periodic JSONL emitter (and the bench) measure over.
-            self._send_json(
-                200, self.frontend.metrics.snapshot(advance=False)
+            # Content-negotiated (obs/http.py): JSON snapshot stays the
+            # default (existing tooling and the bench parse it); an Accept
+            # header naming text/plain or openmetrics — what Prometheus'
+            # scraper sends — selects the text exposition.  advance=False:
+            # a scrape must not reset the rate interval the periodic JSONL
+            # emitter (and the bench) measure over.
+            ctype, body = render_metrics(
+                self.frontend.registry,
+                self.headers.get("Accept"),
+                json_fallback=lambda: self.frontend.metrics.snapshot(
+                    advance=False
+                ),
             )
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif path == "/debug/trace":
+            q = parse_qs(parsed.query)
+            try:
+                steps = int(q["steps"][0]) if "steps" in q else 0
+                timeout_s = (
+                    float(q["timeout_s"][0]) if "timeout_s" in q else 30.0
+                )
+            except ValueError:
+                self._send_json(
+                    400, {"error": "steps/timeout_s must be numeric"}
+                )
+                return
+            # Runs the capture on THIS handler thread (the server is
+            # threading; other requests keep flowing — they are the very
+            # traffic being profiled).
+            self._send_json(200, self.frontend.debug_trace(steps, timeout_s))
         else:
             self._send_json(404, {"error": f"no route {path}"})
 

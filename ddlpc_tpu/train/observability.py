@@ -22,6 +22,9 @@ from typing import Dict, Optional
 import jax
 import numpy as np
 
+from ddlpc_tpu.obs.registry import sanitize_name
+from ddlpc_tpu.obs.schema import SCHEMA_VERSION
+
 # ISPRS-style 6-class palette (imp surface, building, low veg, tree, car,
 # clutter) extended by hashing for datasets with more classes.
 _PALETTE = np.array(
@@ -57,12 +60,21 @@ class MetricsLogger:
         workdir: str,
         run_config_json: Optional[str] = None,
         basename: str = "metrics",
+        registry=None,
     ):
         # ``basename`` lets other subsystems share this stream format
         # without clobbering the training log (serve/metrics.py writes
         # ``serve_metrics.jsonl`` next to ``metrics.jsonl``).
         self.enabled = jax.process_index() == 0
         self.workdir = workdir
+        # Optional MetricsRegistry (obs/registry.py): every numeric scalar
+        # logged here is also published as a gauge so the Prometheus
+        # exposition (/metrics on the telemetry endpoint) always shows the
+        # latest value of everything the JSONL stream carries.
+        self.registry = None
+        self._records_total = None
+        if registry is not None:
+            self.attach_registry(registry)
         if not self.enabled:
             return
         os.makedirs(workdir, exist_ok=True)
@@ -74,6 +86,18 @@ class MetricsLogger:
             with open(os.path.join(workdir, "config.json"), "w") as f:
                 f.write(run_config_json)
 
+    def attach_registry(self, registry) -> None:
+        """Wire (or re-wire) a MetricsRegistry after construction — the
+        serve frontend owns its registry but receives a logger built
+        before it exists, and the quantile snapshots must still reach the
+        Prometheus exposition."""
+        self.registry = registry
+        self._records_total = registry.counter(
+            "ddlpc_log_records_total",
+            "JSONL records written, by record kind.",
+            labelnames=("kind",),
+        )
+
     def log(self, record: Dict[str, object], echo: bool = True) -> None:
         if not self.enabled:
             return
@@ -82,17 +106,38 @@ class MetricsLogger:
             for k, v in record.items()
         }
         record.setdefault("time", time.time())
+        # Every stream record carries the flat-JSONL schema version so any
+        # tool (scripts/obs_tail.py, scripts/check_metrics_schema.py) can
+        # tail/lint training, serving, span, and alert streams identically.
+        record.setdefault("schema", SCHEMA_VERSION)
         with open(self.jsonl_path, "a") as f:
             f.write(json.dumps(record) + "\n")
+        if self.registry is not None:
+            self._publish(record)
         line = "  ".join(
             f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
             for k, v in record.items()
-            if k != "time"
+            if k not in ("time", "schema")
         )
         with open(self.txt_path, "a") as f:
             f.write(line + "\n")
         if echo:
             print(line, flush=True)
+
+    def _publish(self, record: Dict[str, object]) -> None:
+        """Numeric scalars → ``ddlpc_<kind>_<key>`` gauges in the registry."""
+        kind = str(record.get("kind", "train"))
+        self._records_total.inc(kind=kind)
+        prefix = sanitize_name(f"ddlpc_{kind}")
+        for k, v in record.items():
+            if k in ("time", "schema", "kind"):
+                continue
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            self.registry.gauge(
+                f"{prefix}_{sanitize_name(k)}",
+                f"Latest {k!r} from the {kind} JSONL stream.",
+            ).set(float(v))
 
 
 class StageTimer:
@@ -102,11 +147,18 @@ class StageTimer:
 
     Thread-safe: the ShardedLoader's producer pool records its
     loader_gather/cast/upload stages from worker threads concurrently with
-    the training thread's data/step stages."""
+    the training thread's data/step stages.
 
-    def __init__(self):
+    ``tracer`` (obs/tracing.py, optional) additionally records every stage
+    as a span — this is how the loader's per-stage hooks reach the unified
+    trace without the loader knowing the tracer exists.  Stages run on
+    producer threads, so spans are recorded with the tracer's explicit
+    cross-thread ``add_span`` (no implicit parent)."""
+
+    def __init__(self, tracer=None):
         self.totals: Dict[str, float] = {}
         self.counts: Dict[str, int] = {}
+        self.tracer = tracer
         self._lock = threading.Lock()
 
     @contextmanager
@@ -115,10 +167,14 @@ class StageTimer:
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            dt = t1 - t0
             with self._lock:
                 self.totals[name] = self.totals.get(name, 0.0) + dt
                 self.counts[name] = self.counts.get(name, 0) + 1
+            tracer = self.tracer
+            if tracer is not None and tracer.enabled:
+                tracer.add_span(name, t0, t1)
 
     def summary(self) -> Dict[str, float]:
         with self._lock:

@@ -14,6 +14,7 @@ metric), checkpoint/resume, and a config artifact per run.
 from __future__ import annotations
 
 import os
+import signal
 import time
 import warnings
 from typing import Dict, Optional
@@ -40,6 +41,11 @@ from ddlpc_tpu.parallel.train_step import (
     make_train_step,
     make_train_step_gspmd,
 )
+from ddlpc_tpu.obs.health import HealthMonitor
+from ddlpc_tpu.obs.http import TelemetryServer
+from ddlpc_tpu.obs.profiling import OnDemandProfiler
+from ddlpc_tpu.obs.registry import MetricsRegistry
+from ddlpc_tpu.obs.tracing import Tracer
 from ddlpc_tpu.train import checkpoint as ckpt
 from ddlpc_tpu.train.async_checkpoint import AsyncCheckpointer
 from ddlpc_tpu.train.observability import (
@@ -137,11 +143,25 @@ class Trainer:
             grad_clip_norm=cfg.train.grad_clip_norm,
         )
 
+        # Unified telemetry (ddlpc_tpu/obs, docs/OBSERVABILITY.md): one
+        # span tracer + one Prometheus-style registry per training process.
+        # The tracer is constructed unconditionally — disabled it is a
+        # near-free no-op — so every instrumentation site below stays
+        # unconditional too.
+        self.registry = MetricsRegistry()
+        self.tracer = Tracer(
+            enabled=cfg.train.trace and jax.process_index() == 0,
+            service="train",
+            jsonl_path=os.path.join(cfg.workdir, "spans.jsonl"),
+            chrome_path=os.path.join(cfg.workdir, "trace.json"),
+        )
         # Created before the loader so the ShardedLoader can thread its
         # per-stage host timings (loader_gather/cast/upload) into the same
         # epoch records as t_data/t_step (StageTimer is thread-safe; the
-        # stages run on producer threads).
-        self.timer = StageTimer()
+        # stages run on producer threads).  The tracer hook additionally
+        # records every stage — including the loop's data/step stages and
+        # the loader's per-stage hooks — as spans.
+        self.timer = StageTimer(tracer=self.tracer)
         loader_cls = (
             DeviceCachedLoader if cfg.data.device_cache else ShardedLoader
         )
@@ -225,7 +245,11 @@ class Trainer:
         self.start_epoch = 0
         if resume:
             self._restore_synchronized()
-        self.logger = MetricsLogger(self.workdir, run_config_json=cfg.to_json())
+        self.logger = MetricsLogger(
+            self.workdir,
+            run_config_json=cfg.to_json(),
+            registry=self.registry,
+        )
         # Failure detection (SURVEY §5: the reference has none and hangs
         # forever on a dead peer).  Armed by fit(); beats come from the
         # epoch loop's data/step stages.
@@ -234,6 +258,33 @@ class Trainer:
             action=cfg.train.stall_action,
             log_path=os.path.join(self.workdir, "stall.log"),
         )
+        # Health detectors (obs/health.py): EWMA step-time regression and
+        # loss NaN/spike alerts, fed per epoch record, fanning out to the
+        # JSONL stream, the registry, and the watchdog's diagnosis ring.
+        self.health = HealthMonitor(
+            logger=self.logger,
+            registry=self.registry,
+            watchdog=self.watchdog,
+            service="train",
+        )
+        # On-demand profiling (obs/profiling.py): armed by SIGUSR2 (fit
+        # installs the handler) or GET /debug/trace on the telemetry
+        # endpoint; the step loop drives the capture over the next N steps
+        # and the top-ops report lands in the workdir.
+        self.profiler = OnDemandProfiler(
+            out_dir=self.workdir,
+            steps=cfg.train.profile_steps,
+            logger=self.logger,
+            enabled=jax.process_index() == 0,
+        )
+        self.telemetry: Optional[TelemetryServer] = None
+        if cfg.train.telemetry_port >= 0 and jax.process_index() == 0:
+            self.telemetry = TelemetryServer(
+                self.registry,
+                port=cfg.train.telemetry_port,
+                health_fn=self._health_snapshot,
+                arm_profile_fn=self._arm_profile,
+            ).start()
         # Async by default: save() pays only the host snapshot; the chunk/
         # compress/fsync chain overlaps the next epoch's compute on a
         # writer thread, with a barrier (and error re-raise) on the next
@@ -245,6 +296,37 @@ class Trainer:
             compression=cfg.train.checkpoint_compression,
             background=cfg.train.checkpoint_async,
         )
+
+    def _health_snapshot(self) -> dict:
+        return {
+            "status": "ok",
+            "pid": os.getpid(),
+            "alerts": list(self.health.alerts),
+        }
+
+    def _arm_profile(self, steps: int) -> dict:
+        self.profiler.arm(steps if steps > 0 else None)
+        return {
+            "armed": True,
+            "steps": self.profiler.steps,
+            "note": (
+                "capture spans the next N dispatched training steps; the "
+                "top-ops report lands in the run workdir"
+            ),
+        }
+
+    def close(self) -> None:
+        """Release the telemetry endpoint and the tracer's file handles.
+
+        fit() deliberately leaves both running — the endpoint stays
+        scrapeable between/after fits and the tracer supports a
+        subsequent fit — so a caller constructing multiple Trainers in
+        one process (or binding a fixed telemetry_port twice) must close
+        the old one.  Idempotent."""
+        if self.telemetry is not None:
+            self.telemetry.close()
+            self.telemetry = None
+        self.tracer.close()
 
     def _build_train_step(self):
         cfg = self.cfg
@@ -327,12 +409,14 @@ class Trainer:
         losses, accs = [], []
         t_epoch = time.perf_counter()
         it = iter(self.loader)
+        step_idx = 0
+        sync_every = self.cfg.train.trace_sync_every_steps
         while True:
             # Stage-resolved timing: the structured version of the
             # reference's per-stage time.time() prints (кластер.py:265-440).
             # "data" = host wait for the next uploaded super-batch (overlaps
             # compute via the loader's prefetch); "step" = compiled SPMD
-            # step dispatch.
+            # step dispatch.  Both stages double as spans when tracing.
             self.watchdog.beat("data")
             with self.timer.stage("data"):
                 batch = next(it, None)
@@ -343,6 +427,19 @@ class Trainer:
                 self.state, metrics = self.train_step(self.state, *batch)
             losses.append(metrics["loss"])
             accs.append(metrics["pixel_acc"])
+            step_idx += 1
+            # Sampled sync: every K steps a traced run blocks on the step
+            # output so the trace carries REAL step latency at that cadence
+            # — syncing every step would serialize the async dispatch
+            # pipeline and measure a run that doesn't exist.
+            if self.tracer.enabled and sync_every and step_idx % sync_every == 0:
+                with self.tracer.span("step_sync", epoch=epoch, step=step_idx):
+                    jax.block_until_ready(metrics["loss"])
+            # Drive the on-demand profiler (no-op unless armed); the sync
+            # closure drains this step's dispatch queue INTO the capture.
+            self.profiler.step_done(
+                sync=lambda m=metrics: jax.block_until_ready(m["loss"])
+            )
         # One host sync per epoch (metrics stayed on device inside the loop).
         # Single batched device_get: per-element float() would cost one full
         # host round trip PER STEP on tunneled/remote devices (~115 ms each,
@@ -465,19 +562,20 @@ class Trainer:
         # on-disk blob restores bit-identically into either layout.  The
         # gather is a collective: every process runs it, then only process
         # 0 snapshots/writes (AsyncCheckpointer's gate).
-        state = self.layout.canonical(self.state)
-        self.checkpointer.save(
-            self.ckpt_dir,
-            state,
-            step=int(jax.device_get(self.state.step)),
-            metadata={
-                "epoch": epoch,
-                "config": self.cfg.to_dict(),
-                # The predict CLI rebuilds its restore target from this —
-                # channels come from the dataset, not the config (ADVICE r1).
-                "input_channels": int(self.train_ds.image_shape[-1]),
-            },
-        )
+        with self.tracer.span("checkpoint_snapshot", epoch=epoch):
+            state = self.layout.canonical(self.state)
+            self.checkpointer.save(
+                self.ckpt_dir,
+                state,
+                step=int(jax.device_get(self.state.step)),
+                metadata={
+                    "epoch": epoch,
+                    "config": self.cfg.to_dict(),
+                    # The predict CLI rebuilds its restore target from this —
+                    # channels come from the dataset, not the config (ADVICE r1).
+                    "input_channels": int(self.train_ds.image_shape[-1]),
+                },
+            )
 
     def fit(self, epochs: Optional[int] = None) -> Dict[str, float]:
         """Run the full training; returns the last epoch's metrics record."""
@@ -493,40 +591,75 @@ class Trainer:
             )
             self.train_step = self._build_train_step()
         record: Dict[str, float] = {}
-        with self.watchdog:
+        # SIGUSR2 → arm the on-demand profiler (kill -USR2 <pid> against a
+        # live run; the next profile_steps steps are captured and
+        # aggregated).  Installable only from the main thread — tests and
+        # embedded fits from worker threads skip the handler and use
+        # /debug/trace or profiler.arm() directly.
+        prev_handler = None
+        sigusr2 = getattr(signal, "SIGUSR2", None)
+        if sigusr2 is not None:
             try:
-                for epoch in range(self.start_epoch, epochs):
-                    with maybe_profile(
-                        os.path.join(self.workdir, "profile"),
-                        enabled=epoch == cfg.profile_epoch,
-                    ):
-                        record = self.train_epoch(epoch)
-                    if cfg.eval_every_epochs and (epoch + 1) % cfg.eval_every_epochs == 0:
-                        # evaluate() beats per batch; per-batch eval cost is
-                        # step-like, so the step-sized timeout applies.
-                        record.update(self.evaluate())
-                    self.logger.log(record)
-                    if cfg.checkpoint_every_epochs and (
-                        epoch + 1
-                    ) % cfg.checkpoint_every_epochs == 0:
-                        # Snapshot/serialization time is unrelated to the
-                        # step-sized timeout — suspend detection rather than
-                        # mis-size it.  Under checkpoint_async this blocks
-                        # only for the host snapshot (plus a barrier if the
-                        # PREVIOUS write is somehow still running); the write
-                        # itself overlaps the next epoch.
-                        with self.watchdog.paused("checkpoint"):
-                            self.save(epoch)
-                    if cfg.dump_images_per_epoch:
-                        with self.watchdog.paused("image_dump"):
-                            self.dump_images(epoch)
-            finally:
-                # Exit barrier: fit() must not return (or unwind) with a
-                # checkpoint still in flight — this also re-raises a writer
-                # failure on the training thread.  close() additionally
-                # shuts the writer thread down (one leaked non-daemon
-                # thread per Trainer otherwise); a later save()/fit() on
-                # this Trainer transparently respawns it.
-                with self.watchdog.paused("checkpoint_flush"):
-                    self.checkpointer.close()
+                prev_handler = signal.signal(
+                    sigusr2, lambda signum, frame: self.profiler.arm()
+                )
+            except ValueError:
+                pass  # not the main thread
+        try:
+            with self.watchdog:
+                try:
+                    for epoch in range(self.start_epoch, epochs):
+                        with self.tracer.span("epoch", epoch=epoch):
+                            with maybe_profile(
+                                os.path.join(self.workdir, "profile"),
+                                enabled=epoch == cfg.profile_epoch,
+                            ):
+                                record = self.train_epoch(epoch)
+                        if cfg.eval_every_epochs and (epoch + 1) % cfg.eval_every_epochs == 0:
+                            # evaluate() beats per batch; per-batch eval cost is
+                            # step-like, so the step-sized timeout applies.
+                            with self.tracer.span("evaluate", epoch=epoch):
+                                record.update(self.evaluate())
+                        self.logger.log(record)
+                        # Health detectors see exactly what the stream saw.
+                        self.health.observe_train(record)
+                        if cfg.checkpoint_every_epochs and (
+                            epoch + 1
+                        ) % cfg.checkpoint_every_epochs == 0:
+                            # Snapshot/serialization time is unrelated to the
+                            # step-sized timeout — suspend detection rather than
+                            # mis-size it.  Under checkpoint_async this blocks
+                            # only for the host snapshot (plus a barrier if the
+                            # PREVIOUS write is somehow still running); the write
+                            # itself overlaps the next epoch.
+                            with self.watchdog.paused("checkpoint"):
+                                self.save(epoch)
+                        if cfg.dump_images_per_epoch:
+                            with self.watchdog.paused("image_dump"):
+                                self.dump_images(epoch)
+                finally:
+                    # Exit barrier: fit() must not return (or unwind) with a
+                    # checkpoint still in flight — this also re-raises a writer
+                    # failure on the training thread.  close() additionally
+                    # shuts the writer thread down (one leaked non-daemon
+                    # thread per Trainer otherwise); a later save()/fit() on
+                    # this Trainer transparently respawns it.
+                    with self.watchdog.paused("checkpoint_flush"):
+                        with self.tracer.span("checkpoint_barrier"):
+                            self.checkpointer.close()
+        finally:
+            if prev_handler is not None:
+                try:
+                    signal.signal(sigusr2, prev_handler)
+                except ValueError:
+                    pass
+            # A capture the run ended mid-way through still produces its
+            # report over the steps that actually happened.
+            self.profiler.finalize(
+                sync=lambda: jax.block_until_ready(self.state.step)
+            )
+            # Traced runs drop a Perfetto-loadable trace.json in the
+            # workdir at every fit() exit (flush is idempotent; the tracer
+            # stays usable for a subsequent fit on this Trainer).
+            self.tracer.flush()
         return record

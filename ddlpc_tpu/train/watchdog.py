@@ -28,10 +28,12 @@ from __future__ import annotations
 
 import contextlib
 import faulthandler
+import json
 import os
 import sys
 import threading
 import time
+from collections import deque
 from typing import Callable, Iterator, Optional
 
 
@@ -66,6 +68,13 @@ class StallWatchdog:
         self._pause_depth = 0
         self._pause_lock = threading.Lock()
         self.stall_count = 0
+        # Recent health alerts (obs/health.py HealthMonitor feeds these):
+        # a stall diagnosis shows what health was doing just before the
+        # hang — e.g. step-time regressions leading into a wedged
+        # collective.  Bounded; mutation under its own lock (alerts arrive
+        # from arbitrary threads).
+        self._alerts: deque = deque(maxlen=32)
+        self._alerts_lock = threading.Lock()
 
     # -- heartbeat ---------------------------------------------------------
 
@@ -74,6 +83,20 @@ class StallWatchdog:
         self._last = time.monotonic()
         if tag:
             self._tag = tag
+
+    def record_alert(self, record: dict) -> None:
+        """Remember a structured health alert (flat JSONL record shape) for
+        the next stall diagnosis.  Never raises — diagnostics must not break
+        the loop being observed."""
+        try:
+            with self._alerts_lock:
+                self._alerts.append(dict(record))
+        except Exception:
+            pass
+
+    def recent_alerts(self) -> list:
+        with self._alerts_lock:
+            return list(self._alerts)
 
     @contextlib.contextmanager
     def paused(self, tag: str = "paused") -> Iterator[None]:
@@ -147,6 +170,7 @@ class StallWatchdog:
             f"(timeout {self.timeout_s:.1f}s); last phase: {self._tag!r}. "
             f"Process {os.getpid()} thread stacks follow."
         )
+        alerts = self.recent_alerts()
         streams = [sys.stderr]
         fh = None
         try:
@@ -155,6 +179,18 @@ class StallWatchdog:
                 streams.append(fh)
             for s in streams:
                 print(msg, file=s, flush=True)
+                if alerts:
+                    print(
+                        f"[watchdog] {len(alerts)} recent health alert(s) "
+                        f"before the stall:",
+                        file=s,
+                        flush=True,
+                    )
+                    for rec in alerts:
+                        try:
+                            print("  " + json.dumps(rec), file=s, flush=True)
+                        except Exception:
+                            pass
                 try:
                     # All-thread Python stacks: shows whether the loop is
                     # stuck in a device fetch, a collective, or host code.

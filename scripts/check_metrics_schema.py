@@ -1,0 +1,90 @@
+"""Lint the flat-JSONL telemetry stream contract (ddlpc_tpu/obs/schema.py).
+
+Every JSONL stream a run emits — metrics.jsonl, serve_metrics.jsonl,
+spans.jsonl, serve_spans.jsonl — must be one FLAT JSON object per line
+(scalars or lists of scalars) carrying an integer ``schema`` field.  That
+contract is what lets scripts/obs_tail.py tail any stream unchanged and
+lets downstream tooling parse without per-stream special cases; this lint
+(invoked from tier-1: tests/test_obs.py) keeps emitters honest.
+
+Usage:
+    python scripts/check_metrics_schema.py runs/flagship            # run dir
+    python scripts/check_metrics_schema.py a.jsonl b.jsonl          # files
+    python scripts/check_metrics_schema.py --max-violations 5 dir/
+
+Exit status: 0 all records conform, 1 violations found (each printed as
+``path:line: message``), 2 usage/IO error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddlpc_tpu.obs.schema import check_record  # noqa: E402
+
+
+def lint_file(path: str, max_violations: int = 20) -> List[str]:
+    """``path:line: message`` strings for every contract violation."""
+    out: List[str] = []
+    with open(path, "r") as f:
+        for lineno, line in enumerate(f, 1):
+            if len(out) >= max_violations:
+                out.append(f"{path}: ... (further violations suppressed)")
+                break
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                out.append(f"{path}:{lineno}: not valid JSON ({e.msg})")
+                continue
+            for err in check_record(obj):
+                out.append(f"{path}:{lineno}: {err}")
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+", help="JSONL files or run workdirs")
+    ap.add_argument("--max-violations", type=int, default=20,
+                    help="stop reporting per file after this many")
+    args = ap.parse_args(argv)
+
+    files: List[str] = []
+    for p in args.paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(os.path.join(p, "*.jsonl"))))
+        elif os.path.exists(p):
+            files.append(p)
+        else:
+            print(f"check_metrics_schema: no such path {p!r}", file=sys.stderr)
+            return 2
+    if not files:
+        print("check_metrics_schema: no .jsonl files found", file=sys.stderr)
+        return 2
+
+    violations: List[str] = []
+    checked = 0
+    for path in files:
+        checked += 1
+        violations.extend(lint_file(path, max_violations=args.max_violations))
+    for v in violations:
+        print(v)
+    print(
+        f"check_metrics_schema: {checked} file(s), "
+        f"{len(violations)} violation(s)",
+        file=sys.stderr,
+    )
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

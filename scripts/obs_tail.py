@@ -1,0 +1,161 @@
+"""Tail/filter the unified flat-JSONL telemetry streams of a live run.
+
+Every stream in the repo — ``metrics.jsonl`` (train), ``serve_metrics.jsonl``
+(serve), ``spans.jsonl``/``serve_spans.jsonl`` (tracer) — is one flat JSON
+object per line with a ``schema`` field (ddlpc_tpu/obs/schema.py), so one
+tool tails any of them.  Give it files or a run workdir (tails every
+``*.jsonl`` in it).
+
+Usage:
+    python scripts/obs_tail.py runs/flagship                  # whole run dir
+    python scripts/obs_tail.py runs/x/spans.jsonl -f          # follow
+    python scripts/obs_tail.py runs/x --kind span,alert       # by record kind
+    python scripts/obs_tail.py runs/x --where name=jit_execute
+    python scripts/obs_tail.py runs/x --keys loss,step_time_s # trim columns
+    python scripts/obs_tail.py runs/x -n 50                   # last 50/file
+
+Filters:
+    --kind  comma list matched against the record's ``kind`` field
+            (records without one count as kind "train");
+    --where key=value pairs, all must match (string compare on the
+            record's value — ``--where severity=critical``);
+    --keys  comma list of keys to print (plus kind/time), unmatched keys
+            dropped; default prints the whole record.
+
+Output is the raw (possibly trimmed) JSON object per line — pipe into jq
+for anything fancier.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, TextIO
+
+
+def _match(rec: dict, kinds: Optional[set], where: Dict[str, str]) -> bool:
+    if kinds is not None and str(rec.get("kind", "train")) not in kinds:
+        return False
+    for k, v in where.items():
+        if str(rec.get(k)) != v:
+            return False
+    return True
+
+
+def _emit(rec: dict, src: str, keys: Optional[List[str]], out: TextIO) -> None:
+    if keys is not None:
+        rec = {
+            k: rec[k]
+            for k in ("kind", "time", *keys)
+            if k in rec
+        }
+    out.write(f"{src}\t{json.dumps(rec)}\n")
+    out.flush()
+
+
+def _resolve(paths: List[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(os.path.join(p, "*.jsonl"))))
+        else:
+            files.append(p)
+    return files
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+", help="JSONL files or run workdirs")
+    ap.add_argument("-f", "--follow", action="store_true", help="keep tailing")
+    ap.add_argument("-n", "--lines", type=int, default=10,
+                    help="initial lines per file (0 = from the start)")
+    ap.add_argument("--kind", default=None, help="comma list of record kinds")
+    ap.add_argument("--where", action="append", default=[],
+                    metavar="KEY=VALUE", help="field equality filter (repeatable)")
+    ap.add_argument("--keys", default=None, help="comma list of keys to keep")
+    args = ap.parse_args(argv)
+
+    kinds = set(args.kind.split(",")) if args.kind else None
+    keys = args.keys.split(",") if args.keys else None
+    where: Dict[str, str] = {}
+    for w in args.where:
+        if "=" not in w:
+            ap.error(f"--where takes KEY=VALUE, got {w!r}")
+        k, _, v = w.partition("=")
+        where[k] = v
+
+    files = _resolve(args.paths)
+    if not files:
+        print("obs_tail: no .jsonl files found", file=sys.stderr)
+        return 1
+
+    handles: Dict[str, TextIO] = {}
+    for path in files:
+        try:
+            fh = open(path, "r")
+        except OSError as e:
+            print(f"obs_tail: skipping {path}: {e}", file=sys.stderr)
+            continue
+        src = os.path.basename(path)
+        if args.lines:
+            tail = fh.readlines()[-args.lines:]
+        else:
+            tail = fh.readlines()
+        for line in tail:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if _match(rec, kinds, where):
+                _emit(rec, src, keys, sys.stdout)
+        handles[path] = fh
+
+    if not args.follow:
+        for fh in handles.values():
+            fh.close()
+        return 0
+
+    try:
+        while True:
+            idle = True
+            for path, fh in handles.items():
+                while True:
+                    pos = fh.tell()
+                    line = fh.readline()
+                    if not line:
+                        break
+                    if not line.endswith("\n"):
+                        # Torn line mid-write: rewind (text-mode tell()
+                        # cookies are valid seek targets) and re-read whole
+                        # on the next poll.
+                        fh.seek(pos)
+                        break
+                    idle = False
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if _match(rec, kinds, where):
+                        _emit(rec, os.path.basename(path), keys, sys.stdout)
+            if idle:
+                time.sleep(0.25)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        for fh in handles.values():
+            fh.close()
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # Downstream (`| head`) closed the pipe — normal termination for a
+        # tail tool.  Point stdout at devnull so the interpreter's exit
+        # flush doesn't raise a second time.
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

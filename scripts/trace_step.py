@@ -28,7 +28,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from head_bench import CANDIDATES  # noqa: E402
-from xplane_top import self_times  # noqa: E402
+
+from ddlpc_tpu.obs.xplane import self_times  # noqa: E402
 
 from ddlpc_tpu.config import (  # noqa: E402
     CompressionConfig,

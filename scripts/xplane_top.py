@@ -11,68 +11,58 @@ profile would show — that plugin's converter is incompatible with the
 installed TF in this image, so this parses the xplane proto directly.
 This is the tool behind the round-2 findings in docs/PERF.md (the
 gather-based loss and lane-padded conv attributions).
+
+The aggregation itself lives in ``ddlpc_tpu/obs/xplane.py`` — one
+implementation shared with the on-demand profiling hooks (the Trainer's
+SIGUSR2 trigger and the serve ``/debug/trace`` endpoint) so the CLI and
+the live paths can never drift.  ``self_times`` is re-exported here for
+callers of the historical script API (scripts/trace_step.py).
 """
 
 from __future__ import annotations
 
-import collections
-import glob
+import os
 import sys
 
-from tensorflow.tsl.profiler.protobuf import xplane_pb2
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddlpc_tpu.obs.xplane import (  # noqa: E402,F401  (self_times: script API)
+    XplaneUnavailable,
+    self_times,
+    self_times_any,
+)
 
 
-def self_times(trace_dir: str):
-    paths = sorted(glob.glob(f"{trace_dir}/plugins/profile/*/*.xplane.pb"))
-    if not paths:
-        raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
-    xs = xplane_pb2.XSpace()
-    with open(paths[-1], "rb") as f:
-        xs.ParseFromString(f.read())
-    for plane in xs.planes:
-        if not plane.name.startswith("/device:"):
-            continue
-        ev_meta = {k: v.name for k, v in plane.event_metadata.items()}
-        for line in plane.lines:
-            if line.name != "XLA Ops":
-                continue
-            # Sort children after their enclosing parent at equal offsets
-            # (longer event first), or same-start nesting inverts the
-            # parent/child stack and produces negative self-times.
-            evs = sorted(
-                (
-                    (e.offset_ps, -e.duration_ps, ev_meta.get(e.metadata_id, "?"))
-                    for e in line.events
-                ),
-            )
-            evs = [(off, -negdur, name) for off, negdur, name in evs]
-            agg: collections.Counter = collections.Counter()
-            cnt: collections.Counter = collections.Counter()
-            stack: list = []  # [start, end, name, child_time]
-
-            def pop_until(t: float) -> None:
-                while stack and stack[-1][1] <= t:
-                    s, e, n, ct = stack.pop()
-                    agg[n] += (e - s) - ct
-                    cnt[n] += 1
-                    if stack:
-                        stack[-1][3] += e - s
-            for off, dur, name in evs:
-                pop_until(off)
-                stack.append([off, off + dur, name, 0])
-            pop_until(float("inf"))
-            yield plane.name, agg, cnt
-
-
-def main() -> None:
+def main() -> int:
     trace_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/jaxtrace"
     top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 25
-    for plane_name, agg, cnt in self_times(trace_dir):
+    try:
+        planes = list(self_times_any(trace_dir))
+    except XplaneUnavailable as e:
+        # Actionable message instead of a bare ImportError traceback.
+        print(f"xplane_top: {e}", file=sys.stderr)
+        return 2
+    except FileNotFoundError as e:
+        print(
+            f"xplane_top: {e} — pass a jax.profiler trace directory "
+            f"(the one given to jax.profiler.trace/start_trace)",
+            file=sys.stderr,
+        )
+        return 2
+    if not planes:
+        print(
+            f"xplane_top: no device or host XLA planes in {trace_dir} — "
+            f"was any compiled computation dispatched inside the trace?",
+            file=sys.stderr,
+        )
+        return 1
+    for plane_name, agg, cnt in planes:
         total = sum(agg.values())
         print(f"== {plane_name}: total device self-time {total / 1e9:.1f} ms ==")
         for name, ps in agg.most_common(top_n):
             print(f"{ps / 1e9:9.2f} ms x{cnt[name]:<5} {name[:160]}")
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

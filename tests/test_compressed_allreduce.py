@@ -121,6 +121,7 @@ def test_ring_sizes(n):
         np.testing.assert_array_equal(got[d], got[0])
 
 
+@pytest.mark.slow  # convergence-grade; ring math/index/bound tests stay tier-1
 def test_ring_train_step_matches_simulate_closely():
     """A full train step with transport='ring' behaves like the simulate
     codec: same model, same data, losses track within the quantization noise

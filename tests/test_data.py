@@ -548,6 +548,8 @@ def test_eval_batches_padding_masks_labels(mesh):
     assert (labs_tail[2:] == -1).all()
 
 
+@pytest.mark.slow  # convergence-grade; byte-identity of the compact feed
+# itself stays tier-1 in test_native_batch.py
 def test_compact_upload_bit_identical_training(mesh):
     """ShardedLoader(compact=True) ships bf16 images + int8 labels; for a
     bf16-compute model (whose first conv casts inputs to bf16 regardless)

@@ -1,0 +1,652 @@
+"""Unified telemetry (ddlpc_tpu/obs, docs/OBSERVABILITY.md): span tracer +
+exporters, Prometheus-style registry + text exposition, health detectors,
+the telemetry HTTP endpoint, the stream-schema lint, and the on-demand
+profiler round trip."""
+
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+from ddlpc_tpu.obs import SCHEMA_VERSION, check_record
+from ddlpc_tpu.obs.health import (
+    EwmaRegressionDetector,
+    HealthMonitor,
+    LossDetector,
+    QueueSaturationDetector,
+)
+from ddlpc_tpu.obs.http import TelemetryServer, render_metrics, wants_prometheus
+from ddlpc_tpu.obs.registry import MetricsRegistry, sanitize_name
+from ddlpc_tpu.obs.tracing import NULL_SPAN, Tracer
+
+
+# ---- tracer -----------------------------------------------------------------
+
+
+def test_disabled_tracer_is_a_shared_noop(tmp_path):
+    tr = Tracer(enabled=False, jsonl_path=str(tmp_path / "s.jsonl"))
+    # Same singleton every time: a disabled span allocates nothing.
+    assert tr.span("a") is NULL_SPAN
+    assert tr.span("b", k=1) is NULL_SPAN
+    with tr.span("a") as s:
+        s.set(x=1)  # chainable no-op
+    tr.add_span("c", 0.0, 1.0)
+    assert tr.flush() is None
+    assert tr.chrome_events() == []
+    tr.close()
+    # Nothing touched the filesystem.
+    assert not (tmp_path / "s.jsonl").exists()
+
+
+def test_spans_nest_per_thread_and_export_both_formats(tmp_path):
+    jl = str(tmp_path / "spans.jsonl")
+    ct = str(tmp_path / "trace.json")
+    tr = Tracer(enabled=True, service="test", jsonl_path=jl, chrome_path=ct)
+    with tr.span("outer", phase="demo") as outer:
+        with tr.span("inner"):
+            pass
+        outer.set(tiles=3)
+    tr.close()
+
+    recs = [json.loads(l) for l in open(jl)]
+    by_name = {r["name"]: r for r in recs}
+    # Nesting: inner's parent is outer; outer is a root.
+    assert by_name["inner"]["parent_id"] == by_name["outer"]["span_id"]
+    assert by_name["outer"]["parent_id"] == 0
+    assert by_name["outer"]["tiles"] == 3
+    for r in recs:
+        assert r["schema"] == SCHEMA_VERSION
+        assert r["kind"] == "span"
+        assert r["trace_id"] == tr.trace_id
+        assert r["dur_s"] >= 0
+        assert check_record(r) == []
+
+    doc = json.load(open(ct))
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert {e["name"] for e in evs} == {"outer", "inner"}
+    for e in evs:  # the Perfetto-required complete-event fields
+        assert {"ts", "dur", "pid", "tid"} <= set(e)
+    assert any(e.get("ph") == "M" for e in doc["traceEvents"])  # metadata
+    assert doc["metadata"]["dropped_events"] == 0
+
+
+def test_span_records_exception_and_still_closes(tmp_path):
+    jl = str(tmp_path / "s.jsonl")
+    tr = Tracer(enabled=True, jsonl_path=jl)
+    with pytest.raises(RuntimeError):
+        with tr.span("boom"):
+            raise RuntimeError("x")
+    tr.close()
+    (rec,) = [json.loads(l) for l in open(jl)]
+    assert rec["error"] == "RuntimeError"
+
+
+def test_cross_thread_add_span_and_concurrency(tmp_path):
+    tr = Tracer(enabled=True, jsonl_path=str(tmp_path / "s.jsonl"))
+    n_threads, per_thread = 8, 50
+
+    def worker(i):
+        for j in range(per_thread):
+            t0 = tr.now()
+            with tr.span(f"t{i}"):
+                pass
+            tr.add_span("xthread", t0, tr.now(), i=i)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    tr.close()
+    recs = [json.loads(l) for l in open(tmp_path / "s.jsonl")]
+    assert len(recs) == n_threads * per_thread * 2
+    # Span ids are unique under concurrency.
+    ids = [r["span_id"] for r in recs]
+    assert len(set(ids)) == len(ids)
+
+
+def test_chrome_buffer_bounded_overflow_counted(tmp_path):
+    tr = Tracer(enabled=True, max_events=10)
+    for _ in range(25):
+        with tr.span("x"):
+            pass
+    assert tr.dropped_events == 15
+    assert len([e for e in tr.chrome_events() if e.get("ph") == "X"]) == 10
+
+
+# ---- registry ---------------------------------------------------------------
+
+
+def test_counter_gauge_histogram_and_exposition():
+    reg = MetricsRegistry()
+    c = reg.counter("req_total", "Requests.", labelnames=("route",))
+    c.inc(route="/a")
+    c.inc(2, route="/b")
+    with pytest.raises(ValueError):
+        c.inc(-1, route="/a")
+    g = reg.gauge("depth", "Queue depth.")
+    g.set(5)
+    g.inc()
+    g.dec(2)
+    assert g.value() == 4
+    h = reg.histogram("lat", "Latency.", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+
+    text = reg.exposition()
+    lines = text.splitlines()
+    assert "# TYPE req_total counter" in lines
+    assert 'req_total{route="/a"} 1' in lines
+    assert 'req_total{route="/b"} 2' in lines
+    assert "# TYPE depth gauge" in lines
+    assert "depth 4" in lines
+    # Histogram: cumulative buckets + implicit +Inf + sum/count.
+    assert 'lat_bucket{le="0.1"} 1' in lines
+    assert 'lat_bucket{le="1"} 2' in lines
+    assert 'lat_bucket{le="+Inf"} 3' in lines
+    assert "lat_count 3" in lines
+    assert any(l.startswith("lat_sum ") for l in lines)
+
+
+def test_registry_idempotent_and_conflict():
+    reg = MetricsRegistry()
+    a = reg.counter("c", labelnames=("x",))
+    assert reg.counter("c", labelnames=("x",)) is a
+    with pytest.raises(ValueError):
+        reg.gauge("c")  # kind conflict
+    with pytest.raises(ValueError):
+        reg.counter("c", labelnames=("y",))  # label conflict
+    with pytest.raises(ValueError):
+        reg.counter("bad name")
+    with pytest.raises(ValueError):
+        a.inc(y=1)  # wrong label set
+
+
+def test_registry_snapshot_flat():
+    reg = MetricsRegistry()
+    reg.counter("n", labelnames=("k",)).inc(k="v")
+    reg.histogram("h").observe(0.2)
+    snap = reg.snapshot()
+    assert snap['n{k="v"}'] == 1
+    assert snap["h_count"] == 1
+    assert check_record({**snap, "schema": 1}) == []  # flat by construction
+
+
+def test_sanitize_name():
+    assert sanitize_name("val_iou/per-class") == "val_iou_per_class"
+    assert sanitize_name("9lives") == "_9lives"
+
+
+def test_exposition_parses_with_a_strict_scraper():
+    """Parse the exposition the way a Prometheus scraper would: every
+    non-comment line is ``name{labels} value`` with a float value."""
+    reg = MetricsRegistry()
+    reg.counter("a_total", "help text", labelnames=("x",)).inc(x='q"uote')
+    reg.gauge("b").set(2.5)
+    reg.histogram("c", labelnames=("y",)).observe(0.3, y="z")
+    import re
+
+    series = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})? (-?[0-9.e+-]+|[+-]Inf|NaN)$"
+    )
+    for line in reg.exposition().splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert series.match(line), f"unparseable series line: {line!r}"
+
+
+# ---- content negotiation + telemetry endpoint -------------------------------
+
+
+def test_render_metrics_content_negotiation():
+    reg = MetricsRegistry()
+    reg.gauge("g").set(1)
+    ctype, body = render_metrics(reg, None)
+    assert ctype == "application/json"
+    assert json.loads(body)["g"] == 1
+    ctype, body = render_metrics(reg, "text/plain")
+    assert ctype.startswith("text/plain; version=0.0.4")
+    assert b"# TYPE g gauge" in body
+    ctype, _ = render_metrics(reg, "application/openmetrics-text")
+    assert ctype.startswith("text/plain")
+    ctype, body = render_metrics(reg, "application/json", json_fallback=lambda: {"legacy": True})
+    assert json.loads(body) == {"legacy": True}
+    assert not wants_prometheus(None)
+    assert not wants_prometheus("application/json")
+
+
+def test_telemetry_server_routes():
+    reg = MetricsRegistry()
+    reg.counter("hits_total").inc(3)
+    armed = {}
+    srv = TelemetryServer(
+        reg,
+        port=0,
+        health_fn=lambda: {"status": "ok", "alerts": []},
+        arm_profile_fn=lambda steps: armed.update(steps=steps) or {"armed": True},
+    ).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        js = json.load(urllib.request.urlopen(f"{base}/metrics"))
+        assert js["hits_total"] == 3
+        req = urllib.request.Request(f"{base}/metrics", headers={"Accept": "text/plain"})
+        text = urllib.request.urlopen(req).read().decode()
+        assert "hits_total 3" in text.splitlines()
+        assert json.load(urllib.request.urlopen(f"{base}/healthz"))["status"] == "ok"
+        r = json.load(urllib.request.urlopen(f"{base}/debug/trace?steps=7"))
+        assert r["armed"] and armed["steps"] == 7
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/nope")
+        assert ei.value.code == 404
+    finally:
+        srv.close()
+
+
+def test_telemetry_server_trace_route_without_profiler_501():
+    srv = TelemetryServer(MetricsRegistry(), port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/debug/trace")
+        assert ei.value.code == 501
+    finally:
+        srv.close()
+
+
+# ---- health detectors -------------------------------------------------------
+
+
+def test_ewma_regression_warmup_then_fires_then_adapts():
+    det = EwmaRegressionDetector(factor=1.5, alpha=0.5, warmup=3)
+    # Warmup observations never alert, even when wildly different.
+    assert det.observe(10.0) is None
+    assert det.observe(0.1) is None
+    assert det.observe(0.1) is None
+    assert det.observe(0.1) is None  # post-warmup, in line with EWMA
+    a = det.observe(50.0)
+    assert a is not None and a.alert == "step_time_regression"
+    assert a.value == 50.0 and a.threshold < 50.0
+    # A sustained plateau folds into the EWMA and stops alerting.
+    for _ in range(20):
+        last = det.observe(50.0)
+    assert last is None
+
+
+def test_ewma_ignores_nonfinite():
+    det = EwmaRegressionDetector(warmup=0)
+    det.observe(1.0)
+    assert det.observe(float("nan")) is None
+    assert det.observe(float("inf")) is None
+
+
+def test_loss_detector_nan_is_critical_every_time():
+    det = LossDetector()
+    for _ in range(3):
+        a = det.observe(float("nan"))
+        assert a is not None
+        assert a.severity == "critical" and a.alert == "loss_nonfinite"
+    # Alert records are flat stream records.
+    rec = a.record()
+    rec["schema"] = SCHEMA_VERSION
+    assert check_record(rec) == []
+
+
+def test_queue_saturation_latches_and_rearms():
+    det = QueueSaturationDetector(threshold=0.9, consecutive=3)
+    assert det.observe(64, 64) is None  # 1st saturated sample
+    assert det.observe(64, 64) is None  # 2nd
+    a = det.observe(60, 64)  # 3rd consecutive ≥ 0.9 → fires
+    assert a is not None and a.alert == "queue_saturation"
+    assert det.observe(64, 64) is None  # latched: no spam while saturated
+    assert det.observe(10, 64) is None  # recovery re-arms
+    det.observe(64, 64)
+    det.observe(64, 64)
+    assert det.observe(64, 64) is not None  # fires again after re-arm
+
+
+class _FakeLogger:
+    def __init__(self):
+        self.records = []
+
+    def log(self, rec, echo=True):
+        self.records.append(dict(rec))
+
+
+class _FakeWatchdog:
+    def __init__(self):
+        self.alerts = []
+
+    def record_alert(self, rec):
+        self.alerts.append(rec)
+
+
+def test_health_monitor_fans_out_to_logger_registry_watchdog():
+    reg = MetricsRegistry()
+    logger, dog = _FakeLogger(), _FakeWatchdog()
+    mon = HealthMonitor(logger=logger, registry=reg, watchdog=dog, service="train")
+    # Seed the EWMA, then regress.
+    for _ in range(6):
+        mon.observe_train({"loss": 1.0, "step_time_s": 0.1})
+    alerts = mon.observe_train({"loss": float("nan"), "step_time_s": 10.0})
+    kinds = {a.alert for a in alerts}
+    assert kinds == {"loss_nonfinite", "step_time_regression"}
+    assert len(logger.records) == 2 and len(dog.alerts) == 2
+    for rec in logger.records:
+        assert rec["kind"] == "alert" and rec["service"] == "train"
+    counter = reg.get("ddlpc_alerts_total")
+    assert counter.value(alert="loss_nonfinite", severity="critical") == 1
+    assert list(mon.alerts)  # kept for /healthz
+
+
+# ---- MetricsLogger / StageTimer integration --------------------------------
+
+
+def test_metrics_logger_stamps_schema_and_publishes_gauges(tmp_path):
+    from ddlpc_tpu.train.observability import MetricsLogger
+
+    reg = MetricsRegistry()
+    logger = MetricsLogger(str(tmp_path), registry=reg)
+    logger.log({"loss": 0.5, "epoch": 3, "note": "text"}, echo=False)
+    (rec,) = [json.loads(l) for l in open(tmp_path / "metrics.jsonl")]
+    assert rec["schema"] == SCHEMA_VERSION
+    assert check_record(rec) == []
+    assert reg.get("ddlpc_train_loss").value() == 0.5
+    assert reg.get("ddlpc_train_epoch").value() == 3
+    assert reg.get("ddlpc_train_note") is None  # strings are not gauges
+    assert reg.get("ddlpc_log_records_total").value(kind="train") == 1
+
+
+def test_stage_timer_concurrent_producers(tmp_path):
+    """Satellite: StageTimer accounting must be exact under the loader's
+    producer-pool concurrency (every stage from every thread counted)."""
+    from ddlpc_tpu.train.observability import StageTimer
+
+    tr = Tracer(enabled=True, jsonl_path=str(tmp_path / "s.jsonl"))
+    timer = StageTimer(tracer=tr)
+    n_threads, per_thread = 8, 100
+
+    def worker(i):
+        for _ in range(per_thread):
+            with timer.stage("gather"):
+                pass
+            with timer.stage(f"own_{i % 2}"):
+                pass
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert timer.counts["gather"] == n_threads * per_thread
+    assert timer.counts["own_0"] == n_threads // 2 * per_thread
+    assert timer.counts["own_1"] == n_threads // 2 * per_thread
+    assert all(v >= 0 for v in timer.totals.values())
+    tr.close()
+    # Every stage also became a span via the cross-thread hook.
+    recs = [json.loads(l) for l in open(tmp_path / "s.jsonl")]
+    assert len(recs) == 2 * n_threads * per_thread
+
+
+# ---- stream schema lint + obs_tail ------------------------------------------
+
+
+def test_check_record_violations():
+    assert check_record([1, 2]) == ["record is list, not a JSON object"]
+    assert any("schema" in e for e in check_record({"a": 1}))
+    assert any("integer" in e for e in check_record({"schema": True}))
+    assert any("nested" in e or "flat" in e for e in check_record({"schema": 1, "d": {"x": 1}}))
+    assert check_record({"schema": 1, "l": [1, "a", None]}) == []
+
+
+def test_schema_lint_script_green_on_real_streams(tmp_path):
+    """Tier-1 invocation of scripts/check_metrics_schema.py: every stream
+    the subsystem emits (metrics, spans, alerts) must pass the lint, and a
+    contract breach must be caught."""
+    from ddlpc_tpu.train.observability import MetricsLogger
+
+    import check_metrics_schema as lint  # scripts/ on sys.path via conftest
+
+    reg = MetricsRegistry()
+    tr = Tracer(enabled=True, jsonl_path=str(tmp_path / "spans.jsonl"))
+    with tr.span("phase"):
+        pass
+    tr.close()
+    logger = MetricsLogger(str(tmp_path), registry=reg)
+    mon = HealthMonitor(logger=logger, registry=reg)
+    logger.log({"loss": 1.0, "val_iou_per_class": [0.1, 0.2]}, echo=False)
+    mon.emit(
+        LossDetector().observe(float("nan"))
+    )
+    assert lint.main([str(tmp_path)]) == 0
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"no_schema": 1}\n{"schema": 1, "nested": {"x": 2}}\nnot json\n')
+    assert lint.main([str(bad)]) == 1
+    errs = lint.lint_file(str(bad))
+    assert len(errs) == 3
+
+
+def test_obs_tail_filters(tmp_path, capsys):
+    import obs_tail
+
+    p = tmp_path / "m.jsonl"
+    p.write_text(
+        json.dumps({"schema": 1, "kind": "span", "name": "step", "dur_s": 1}) + "\n"
+        + json.dumps({"schema": 1, "kind": "alert", "severity": "critical"}) + "\n"
+        + json.dumps({"schema": 1, "loss": 0.5, "epoch": 1}) + "\n"
+    )
+    assert obs_tail.main([str(tmp_path), "--kind", "span", "-n", "0"]) == 0
+    out = capsys.readouterr().out
+    assert out.count("\n") == 1 and '"name": "step"' in out
+    # kind-less records count as "train"; --where and --keys filter/trim.
+    assert obs_tail.main(
+        [str(p), "--kind", "train", "--keys", "loss", "-n", "0"]
+    ) == 0
+    out = capsys.readouterr().out
+    assert '"loss": 0.5' in out and "epoch" not in out
+    assert obs_tail.main([str(p), "--where", "severity=critical", "-n", "0"]) == 0
+    assert '"alert"' in capsys.readouterr().out
+
+
+# ---- serve metrics registry + windowed occupancy ----------------------------
+
+
+def test_serve_occupancy_is_windowed_not_lifetime():
+    from ddlpc_tpu.serve.metrics import ServeMetrics
+
+    m = ServeMetrics(window=4)
+    for _ in range(100):
+        m.record_batch(1, 8)  # long cold-start ramp at 0.125
+    for _ in range(4):
+        m.record_batch(8, 8)  # steady state fills the window
+    snap = m.snapshot()
+    # Lifetime mean would be ~0.16; the window has aged the ramp out.
+    assert snap["batch_occupancy"] == 1.0
+
+
+def test_serve_metrics_publish_prometheus_series():
+    from ddlpc_tpu.serve.metrics import ServeMetrics
+
+    reg = MetricsRegistry()
+    m = ServeMetrics(window=8, registry=reg)
+    m.record_request(0.05, tiles=4)
+    m.record_batch(4, 8)
+    m.record_shed(2)
+    m.record_deadline()
+    m.set_queue_depth(3)
+    text = reg.exposition()
+    assert "ddlpc_serve_requests_total 1" in text
+    assert "ddlpc_serve_tiles_total 4" in text
+    assert "ddlpc_serve_batch_occupancy 0.5" in text
+    assert "ddlpc_serve_shed_total 2" in text
+    assert "ddlpc_serve_deadline_exceeded_total 1" in text
+    assert "ddlpc_serve_queue_depth 3" in text
+    assert "ddlpc_serve_request_latency_seconds_count 1" in text
+
+
+class _FakeEngine:
+    version = 1
+    checkpoint_step = 1
+    tile = (32, 32)
+    channels = 3
+    compiled_shapes = []
+
+    def forward_windows(self, windows):
+        return [np.zeros((32, 32, 4), np.float32) for _ in windows]
+
+
+def test_serve_frontend_adopts_loggers_registry(tmp_path):
+    """The serve CLI builds its MetricsLogger before the frontend (and its
+    registry) exists; the frontend must wire them so the periodic quantile
+    snapshots reach the Prometheus exposition."""
+    from ddlpc_tpu.config import ServeConfig
+    from ddlpc_tpu.serve.server import ServingFrontend
+    from ddlpc_tpu.train.observability import MetricsLogger
+
+    logger = MetricsLogger(str(tmp_path), basename="serve_metrics")
+    assert logger.registry is None
+    fe = ServingFrontend(
+        _FakeEngine(), ServeConfig(workdir=str(tmp_path)), logger=logger
+    )
+    try:
+        assert logger.registry is fe.registry
+        fe.metrics.record_request(0.05, tiles=4)
+        fe.metrics.emit(logger)  # the periodic snapshot record
+        text = fe.registry.exposition()
+        assert "ddlpc_serve_p99_ms" in text
+        assert 'ddlpc_log_records_total{kind="serve"} 1' in text
+    finally:
+        fe.close()
+
+
+def test_serve_http_metrics_content_negotiated(tmp_path):
+    from ddlpc_tpu.config import ServeConfig
+    from ddlpc_tpu.serve.server import ServingFrontend, make_server
+
+    fe = ServingFrontend(_FakeEngine(), ServeConfig(workdir=str(tmp_path)))
+    srv = make_server(fe, host="127.0.0.1", port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        fe.batcher.submit(np.zeros((32, 32, 3), np.uint8)).result(timeout=10)
+        # Default stays the legacy JSON snapshot (bench/tooling contract).
+        js = json.load(urllib.request.urlopen(f"{base}/metrics"))
+        assert js["kind"] == "serve" and js["requests"] == 0  # tile-level submit
+        req = urllib.request.Request(f"{base}/metrics", headers={"Accept": "text/plain"})
+        text = urllib.request.urlopen(req).read().decode()
+        assert "# TYPE ddlpc_serve_batches_total counter" in text
+        assert "ddlpc_serve_batches_total 1" in text
+    finally:
+        srv.shutdown()
+        fe.close()
+
+
+# ---- watchdog diagnosis -----------------------------------------------------
+
+
+def test_watchdog_diagnose_dumps_stacks_and_alerts(tmp_path, capsys):
+    """Satellite: _diagnose (untested before this PR) must write the stall
+    banner, all-thread stacks, and the recent health alerts to both stderr
+    and the log file."""
+    from ddlpc_tpu.train.watchdog import StallWatchdog
+
+    log = str(tmp_path / "stall.log")
+    dog = StallWatchdog(timeout_s=60.0, action="dump", log_path=log)
+    dog.record_alert({"kind": "alert", "alert": "loss_spike", "value": 9.9})
+    dog.record_alert({"kind": "alert", "alert": "step_time_regression"})
+    dog._tag = "step"
+    dog._diagnose(61.0)
+    err = capsys.readouterr().err
+    body = open(log).read()
+    for text in (err, body):
+        assert "no heartbeat for 61.0s" in text
+        assert "last phase: 'step'" in text
+        assert "2 recent health alert(s)" in text
+        assert "loss_spike" in text
+    # faulthandler dumps to the raw fd, so capsys misses it — assert the
+    # stack dump only in the log file: it names at least the current thread.
+    assert "Current thread" in body or "Thread" in body
+    assert dog.recent_alerts()[0]["alert"] == "loss_spike"
+
+
+def test_watchdog_record_alert_bounded():
+    from ddlpc_tpu.train.watchdog import StallWatchdog
+
+    dog = StallWatchdog(timeout_s=60.0)
+    for i in range(100):
+        dog.record_alert({"i": i})
+    kept = dog.recent_alerts()
+    assert len(kept) == 32 and kept[-1]["i"] == 99
+
+
+# ---- on-demand profiler round trip ------------------------------------------
+
+
+def test_ondemand_profiler_round_trip(tmp_path):
+    """Arm → N step_done calls → xplane capture → top-ops JSON on disk:
+    the full trigger path the Trainer drives, minus the Trainer."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddlpc_tpu.obs.profiling import OnDemandProfiler
+    from ddlpc_tpu.obs.xplane import have_xplane
+
+    f = jax.jit(lambda x: (x @ x).sum())
+    x = jnp.ones((128, 128))
+    prof = OnDemandProfiler(out_dir=str(tmp_path), steps=2)
+    assert prof.step_done() is None  # unarmed: free no-op
+    prof.arm(steps=2)
+    out = f(x)
+    assert prof.step_done(sync=lambda: out.block_until_ready()) is None  # starts
+    out = f(x)
+    assert prof.step_done(sync=lambda: out.block_until_ready()) is None
+    out = f(x)
+    report = prof.step_done(sync=lambda: out.block_until_ready())
+    assert report is not None
+    assert os.path.isdir(tmp_path / "profile_001")
+    path = tmp_path / "top_ops_001.json"
+    assert path.exists()
+    on_disk = json.load(open(path))
+    assert on_disk["steps_traced"] == 2
+    if have_xplane():
+        assert "error" not in on_disk
+        assert on_disk["top_self_time"], "no ops aggregated from the trace"
+        assert on_disk["per_step_ms"] >= 0
+    else:
+        assert "error" in on_disk and "xplane" in on_disk["error"]
+
+
+def test_profiler_finalize_closes_short_capture(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from ddlpc_tpu.obs.profiling import OnDemandProfiler
+
+    f = jax.jit(lambda x: x * 2)
+    x = jnp.ones((8,))
+    prof = OnDemandProfiler(out_dir=str(tmp_path), steps=100)
+    prof.arm()
+    out = f(x)
+    prof.step_done(sync=lambda: out.block_until_ready())  # capture starts
+    report = prof.finalize(sync=lambda: out.block_until_ready())
+    assert report is not None  # the arm was not silently lost
+    assert (tmp_path / "top_ops_001.json").exists()
+    assert prof.steps == 100  # requested count restored
+
+
+def test_xplane_unavailable_is_actionable(tmp_path, monkeypatch):
+    from ddlpc_tpu.obs import profiling, xplane
+
+    def boom():
+        raise xplane.XplaneUnavailable(xplane.XPLANE_IMPORT_HINT)
+
+    monkeypatch.setattr(xplane, "_load_pb2", boom)
+    report = profiling.aggregate(str(tmp_path), steps=4, tag="t")
+    assert "error" in report and "TensorBoard/xprof" in report["error"]

@@ -70,6 +70,7 @@ def test_optimizer_steps_follow_schedule():
     assert np.isfinite(deltas).all()
 
 
+@pytest.mark.slow  # schedule math pinned fast above; trainer e2e is elsewhere
 def test_trainer_cosine_end_to_end(tmp_path):
     from ddlpc_tpu.config import DataConfig, ExperimentConfig, ModelConfig
     from ddlpc_tpu.train.trainer import Trainer
