@@ -239,6 +239,15 @@ class TrainConfig:
     # first-compile + slowest-step bound; 0 disables.
     stall_timeout_s: float = 0.0
     stall_action: str = "dump"  # dump | abort
+    # Preemption-graceful shutdown (ddlpc_tpu/resilience, docs/RESILIENCE.md).
+    # On SIGTERM the trainer finishes the in-flight step, writes an
+    # emergency checkpoint (mid-epoch position recorded, so the resume
+    # skip-replays to the exact step and stays bit-identical with an
+    # uninterrupted run), drains telemetry, and exits with status 43 —
+    # which a supervisor treats as a clean restartable exit.  This is the
+    # grace window: if the graceful path has not finished within it, the
+    # process hard-exits (the last durable checkpoint still resumes).
+    preempt_grace_s: float = 30.0
     # Unified telemetry (ddlpc_tpu/obs, docs/OBSERVABILITY.md).
     # trace=True arms the span tracer: per-phase spans (data wait, step
     # dispatch, loader gather/cast/upload, checkpoint, eval) stream to

@@ -1,7 +1,8 @@
 """The one-flat-JSON-object-per-line record contract, in code.
 
 Every JSONL stream in the repo — ``metrics.jsonl``, ``serve_metrics.jsonl``,
-``spans.jsonl``, ``serve_spans.jsonl`` — carries records of this shape, so
+``spans.jsonl``, ``serve_spans.jsonl``, ``resilience.jsonl`` — carries
+records of this shape, so
 one tool (``scripts/obs_tail.py``) tails any of them and one lint
 (``scripts/check_metrics_schema.py``, invoked from tier-1) keeps emitters
 honest.  :func:`check_record` is the single owner of what "flat" means.

@@ -1,0 +1,29 @@
+"""Resilience subsystem: the detect → abort → restart → resume story as
+shipped product (docs/RESILIENCE.md).
+
+The reference hangs forever on a dead peer (кластер.py:215-220, SURVEY §5)
+and has no checkpoint to come back to.  This package closes the loop the
+repo already had the pieces for:
+
+- :mod:`protocol` — the structured exit-status + breadcrumb contract
+  between a training process and whatever supervises it;
+- :mod:`supervisor` — a process supervisor that relaunches training with
+  exponential backoff + jitter, distinguishes exit causes, detects crash
+  loops, and emits ``ddlpc_restarts_total{cause}``;
+- :mod:`chaos` — env-var-driven fault injection (kill, stall, NaN loss,
+  checkpoint bit-flip, disk-full, slow loader) used by the tests and
+  ``scripts/chaos_soak.py``.
+"""
+
+from ddlpc_tpu.resilience.protocol import (  # noqa: F401
+    EXIT_CLEAN,
+    EXIT_PREEMPTED,
+    EXIT_STALL,
+    read_breadcrumb,
+    write_breadcrumb,
+)
+from ddlpc_tpu.resilience.supervisor import (  # noqa: F401
+    Supervisor,
+    SupervisorResult,
+    classify_exit,
+)

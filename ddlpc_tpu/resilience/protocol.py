@@ -1,0 +1,92 @@
+"""The exit-status + breadcrumb protocol between trainer and supervisor.
+
+A supervised training process communicates its fate through two channels
+that survive the process itself:
+
+- **exit status** — coarse, always present:
+
+  ========== =============== ==========================================
+  status     name            meaning
+  ========== =============== ==========================================
+  0          EXIT_CLEAN      run completed all epochs
+  42         EXIT_STALL      watchdog abort: heartbeat went quiet
+                             (train/watchdog.py — pre-existing contract)
+  43         EXIT_PREEMPTED  preemption-graceful shutdown: the in-flight
+                             step finished, an emergency checkpoint was
+                             written, telemetry drained
+  < 0 / 137  (signal)        killed from outside (SIGKILL ⇒ possible OOM)
+  other      (crash)         unhandled exception, import error, ...
+  ========== =============== ==========================================
+
+- **breadcrumb** — ``<workdir>/breadcrumb.json``, a tiny atomically-replaced
+  JSON file the trainer rewrites at phase transitions (running → per-
+  checkpoint progress → preempted/stalled/done).  The supervisor reads it
+  after every exit to refine the coarse status: a ``-9`` with a breadcrumb
+  still in phase ``running`` reads as an external kill/OOM, a ``43`` whose
+  breadcrumb says ``preempt_timeout`` means the grace window expired before
+  the emergency checkpoint landed (resume falls back to the previous one).
+
+Deliberately stdlib-only: the supervisor imports this without paying the
+jax import, so the parent process that must outlive crashes stays light.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+import time
+from typing import Optional
+
+EXIT_CLEAN = 0
+EXIT_STALL = 42  # StallWatchdog's distinctive abort status (pre-existing)
+EXIT_PREEMPTED = 43  # graceful preemption shutdown (trainer SIGTERM path)
+
+BREADCRUMB = "breadcrumb.json"
+
+# Mirror of train/checkpoint.py's _CKPT_RE, duplicated so the supervisor
+# can measure checkpoint progress without importing jax/flax.  Quarantined
+# ``*.bad`` blobs deliberately do not match — they are not progress.
+_CKPT_RE = re.compile(r"^ckpt_(\d+)\.(?:msgpack\.z|dwc)$")
+
+
+def write_breadcrumb(workdir: str, phase: str, **fields) -> None:
+    """Atomically rewrite the breadcrumb.  Best-effort: diagnostics must
+    never take down the run they describe — every failure is swallowed."""
+    try:
+        os.makedirs(workdir, exist_ok=True)
+        crumb = {
+            "schema": 1,
+            "phase": phase,
+            "pid": os.getpid(),
+            "time": time.time(),
+        }
+        crumb.update(fields)
+        fd, tmp = tempfile.mkstemp(dir=workdir, suffix=".crumb.tmp")
+        with os.fdopen(fd, "w") as f:
+            json.dump(crumb, f)
+        os.replace(tmp, os.path.join(workdir, BREADCRUMB))
+    except Exception:
+        pass
+
+
+def read_breadcrumb(workdir: str) -> Optional[dict]:
+    """The last breadcrumb, or None (missing, torn, or unreadable)."""
+    try:
+        with open(os.path.join(workdir, BREADCRUMB)) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
+def latest_checkpoint_step(ckpt_dir: str) -> Optional[int]:
+    """Newest live checkpoint step in ``ckpt_dir`` without importing jax —
+    the supervisor's progress signal (crash loops are 'N failures without
+    THIS advancing')."""
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return None
+    steps = [int(m.group(1)) for m in map(_CKPT_RE.match, names) if m]
+    return max(steps) if steps else None

@@ -227,7 +227,13 @@ class InferenceEngine:
         step = ckpt.latest_step(ckpt_dir)
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {ckpt_dir}")
-        meta = ckpt.peek_metadata(ckpt_dir, step)
+        try:
+            meta = ckpt.peek_metadata(ckpt_dir, step)
+        except (OSError, *ckpt.CorruptionError):
+            # A corrupt newest sidecar must not abort cold start: the
+            # restore below quarantines/falls back on its own, and the
+            # restored metadata re-supplies the channel count.
+            meta = {}
         channels = int(meta.get("input_channels", 3))
         # Inference is single-device: no mesh axis for BN stats.
         model = build_model(cfg.model, norm_axis_name=None)
@@ -239,6 +245,10 @@ class InferenceEngine:
             model, tx, jax.random.key(0), (1, h, w, channels)
         )
         state, meta = ckpt.restore_checkpoint(ckpt_dir, target)
+        # The restore may have fallen back past the step peeked above
+        # (target supplies structure only, so a channel-count guess never
+        # constrains the restored leaves) — trust the restored metadata.
+        channels = int(meta.get("input_channels", channels))
         if echo:
             print(
                 f"restored step {meta.get('step')} (epoch {meta.get('epoch')})"

@@ -36,6 +36,7 @@ import numpy as np
 
 from ddlpc_tpu.config import ServeConfig
 from ddlpc_tpu.obs import profiling as _profiling
+from ddlpc_tpu.obs.health import Alert as HealthAlert
 from ddlpc_tpu.obs.health import HealthMonitor
 from ddlpc_tpu.obs.http import render_metrics
 from ddlpc_tpu.obs.registry import MetricsRegistry
@@ -98,6 +99,16 @@ class ServingFrontend:
             logger=logger, registry=self.registry, service="serve"
         )
         self.draining = False
+        # Failed hot-reloads (corrupt/truncated/missing checkpoints): the
+        # engine keeps serving the CURRENT params; the failure is counted,
+        # alerted, and surfaced on /healthz — never raised into a handler.
+        self._reload_errors = self.registry.counter(
+            "ddlpc_serve_reload_errors_total",
+            "Hot-reload attempts that failed (engine kept serving the "
+            "previous weights), by error type.",
+            labelnames=("error",),
+        )
+        self.last_reload_error: Optional[str] = None
         self._profile_lock = threading.Lock()
         self._profile_n = 0
         self._emit_stop = threading.Event()
@@ -196,7 +207,55 @@ class ServingFrontend:
         )
 
     def reload(self, workdir: Optional[str] = None) -> dict:
-        meta = self.engine.reload(workdir)
+        """Hot-reload; NEVER raises (ISSUE 7 satellite).
+
+        The checkpoint reader already quarantines a corrupt newest blob and
+        falls back to the next-newest (train/checkpoint.py); this catch is
+        the last line — no checkpoints left, unreadable disk, anything —
+        and its contract is: keep serving the current weights, return a
+        structured ``{"error": ...}`` the HTTP layer maps to a non-200,
+        count it, and alert.  The engine's state is untouched on failure
+        (the restore runs off-lock BEFORE the reference swap).
+        """
+        try:
+            meta = self.engine.reload(workdir)
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+            self.last_reload_error = err
+            self._reload_errors.inc(error=type(e).__name__)
+            self.health.emit(
+                HealthAlert(
+                    alert="reload_failed",
+                    severity="warn",
+                    message=f"hot-reload failed, serving previous weights: {err}",
+                    value=float(self.engine.version),
+                    threshold=0.0,
+                )
+            )
+            return {
+                "error": err,
+                "error_type": type(e).__name__,
+                # What we are STILL serving — the caller's recovery signal.
+                "version": self.engine.version,
+                "checkpoint_step": self.engine.checkpoint_step,
+            }
+        self.last_reload_error = None
+        if meta.get("quarantined_steps"):
+            # The reader fell back past corrupt blob(s): serving continues
+            # on an older checkpoint — loud, but not an error.
+            self.health.emit(
+                HealthAlert(
+                    alert="checkpoint_quarantined",
+                    severity="warn",
+                    message=(
+                        f"reload quarantined corrupt checkpoint step(s) "
+                        f"{meta['quarantined_steps']}, restored step "
+                        f"{meta.get('step')}"
+                    ),
+                    value=float(meta.get("step") or 0),
+                    threshold=0.0,
+                )
+            )
         if self.logger is not None:
             self.logger.log(
                 {
@@ -219,6 +278,7 @@ class ServingFrontend:
             "channels": self.engine.channels,
             "queue_depth": self.batcher.queue_depth,
             "compiled_shapes": self.engine.compiled_shapes,
+            "last_reload_error": self.last_reload_error,
             "alerts": list(self.health.alerts),
         }
 
@@ -419,21 +479,37 @@ class _Handler(BaseHTTPRequestHandler):
     def _reload(self, body: bytes) -> None:
         try:
             req = json.loads(body) if body else {}
+        except ValueError as e:
+            self._send_json(400, {"error": f"body is not valid JSON: {e}"})
+            return
+        # frontend.reload catches restore failures into a structured
+        # {"error": ...} while the engine keeps serving the old weights —
+        # mapped to a non-200 here so callers see the failure, but the
+        # serving process never dies over a bad blob.  The outer guard is
+        # the last resort for its SUCCESS path (metrics log, alert emit —
+        # e.g. ENOSPC mid-write): a JSON 500 beats a dropped socket.
+        try:
             meta = self.frontend.reload(req.get("workdir"))
-        except FileNotFoundError as e:
-            self._send_json(404, {"error": str(e)})
         except Exception as e:
-            self._send_json(500, {"error": str(e)})
-        else:
-            self._send_json(
-                200,
-                {"version": self.frontend.engine.version,
-                 "step": meta.get("step"),
-                 # What the swap cost and which on-disk format served it
-                 # (train/checkpoint.py dispatching reader).
-                 "restore_seconds": meta.get("restore_seconds"),
-                 "restore_format": meta.get("restore_format")},
-            )
+            self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        if "error" in meta:
+            code = 404 if meta.get("error_type") == "FileNotFoundError" else 503
+            self._send_json(code, meta)
+            return
+        resp = {
+            "version": self.frontend.engine.version,
+            "step": meta.get("step"),
+            # What the swap cost and which on-disk format served it
+            # (train/checkpoint.py dispatching reader).
+            "restore_seconds": meta.get("restore_seconds"),
+            "restore_format": meta.get("restore_format"),
+        }
+        if meta.get("quarantined_steps"):
+            # Succeeded via fallback: corrupt newer blob(s) were renamed
+            # *.bad and an older checkpoint restored.
+            resp["quarantined_steps"] = meta["quarantined_steps"]
+        self._send_json(200, resp)
 
 
 def make_server(

@@ -68,11 +68,17 @@ def parse_config(argv=None) -> tuple[ExperimentConfig, bool]:
 
 def main(argv=None) -> int:
     cfg, resume = parse_config(argv)
+    from ddlpc_tpu.resilience.protocol import EXIT_PREEMPTED
     from ddlpc_tpu.train.trainer import Trainer
 
     trainer = Trainer(cfg, resume=resume)
     record = trainer.fit()
     print({k: round(v, 4) if isinstance(v, float) else v for k, v in record.items()})
+    if trainer.preempted:
+        # Distinct restartable-clean status (resilience/protocol.py): the
+        # supervisor relaunches without backoff and the resume skip-replays
+        # to the exact preempted step.
+        return EXIT_PREEMPTED
     return 0
 
 

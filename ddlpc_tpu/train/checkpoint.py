@@ -17,12 +17,24 @@ anywhere; a crash loses the run).  Two on-disk formats, one reader:
   which file exists, so pre-chunked runs resume bit-identically
   (docs/CHECKPOINTS.md has the compat matrix).
 
+Integrity (format version 2, this PR): every chunk frame carries a CRC32
+in the footer manifest and the manifest itself is CRC'd in the footer, so
+a flipped bit anywhere in the blob is DETECTED at restore instead of
+silently becoming weights.  On corruption the restore dispatcher
+quarantines the blob (rename to ``*.bad`` — evidence kept, never counted
+as a checkpoint again) and falls back to the next-newest complete
+checkpoint; only when nothing restorable remains does it raise.  Version-1
+blobs (no CRCs) still restore bit-identically — verification is simply
+skipped for them (docs/CHECKPOINTS.md compat matrix).
+
 Shared invariants, identical in both formats:
 
 - Writes are atomic and durable (tmp file + fsync + rename + directory
   fsync) and pruned to ``keep`` newest, so neither a process crash
   mid-write nor a power loss after _prune can leave a renamed-but-empty
-  blob as the only checkpoint.
+  blob as the only checkpoint.  Pruning never removes the newest
+  checkpoint whose footer still verifies — if the newest blob on disk is
+  corrupt, the one restore would fall back to survives any ``keep``.
 - The JSON metadata sidecar is renamed into place BEFORE the blob
   (latest_step keys on the blob, so a crash between the renames leaves a
   harmless orphan .json, never a blob with lost metadata).
@@ -41,12 +53,15 @@ import os
 import re
 import struct
 import tempfile
+import warnings
+import zlib
 from typing import Any, Iterator, List, Optional, Tuple
 
 import jax
 import numpy as np
 from flax import serialization
 
+from ddlpc_tpu.resilience.chaos import active as _chaos_active
 from ddlpc_tpu.utils import wire
 
 PyTree = Any
@@ -56,10 +71,29 @@ _META_RE = re.compile(r"^ckpt_(\d+)\.json$")
 
 # Chunked-format framing: header magic, then streamed DWZ1 chunk frames,
 # then the JSON manifest, then a fixed-size footer locating the manifest.
+# Footer v1 (b"DWCK"): no integrity data.  Footer v2 (b"DWC2") adds a
+# CRC32 of the manifest bytes; v2 manifests carry a CRC32 per chunk frame.
+# The header magic stays DWCK0001 for both — readers dispatch on the TAIL.
 _DWC_MAGIC = b"DWCK0001"
 _DWC_FOOTER = struct.Struct("<QI4s")  # manifest_offset u64, manifest_len u32, b"DWCK"
+_DWC2_FOOTER = struct.Struct("<QII4s")  # + manifest_crc32 u32, b"DWC2"
 CHUNK_BYTES = 4 << 20  # bound on raw bytes per compression/IO unit
 _BLOB_SUFFIXES = (".dwc", ".msgpack.z")
+
+# Exception shapes a corrupt/truncated blob can surface as anywhere in the
+# read path (footer parse, manifest decode, chunk inflate, flax restore).
+# OSErrors are deliberately excluded: an unreadable DISK is an environment
+# problem the fallback must not paper over with an older checkpoint.
+CorruptionError = (
+    ValueError,  # includes json.JSONDecodeError and flax mismatches
+    KeyError,
+    IndexError,
+    TypeError,
+    struct.error,
+    zlib.error,
+    EOFError,
+    OverflowError,
+)
 
 
 # ---------------------------------------------------------------------------
@@ -204,7 +238,7 @@ def _write_chunked(
             "kind": "array",
             "dtype": arr.dtype.name,
             "shape": list(arr.shape),
-            "chunks": [],  # [offset, comp_len, raw_len]
+            "chunks": [],  # [offset, comp_len, raw_len, frame_crc32]
         }
         leaves.append(entry)
         array_entries.append((entry, _leaf_chunks(arr, chunk_bytes)))
@@ -223,26 +257,68 @@ def _write_chunked(
         for raw_len in raw_lens:
             frame = next(frames)
             f.write(frame)
-            entry["chunks"].append([offset, len(frame), raw_len])
+            # CRC the frame AS STORED (post-compression): verification can
+            # then run at read speed without inflating anything, and any
+            # on-disk flip — payload, frame header, stored block — trips it.
+            entry["chunks"].append(
+                [offset, len(frame), raw_len, zlib.crc32(frame)]
+            )
             offset += len(frame)
-    manifest = json.dumps({"version": 1, "leaves": leaves}).encode()
+    manifest = json.dumps({"version": 2, "leaves": leaves}).encode()
     f.write(manifest)
-    f.write(_DWC_FOOTER.pack(offset, len(manifest), b"DWCK"))
+    f.write(
+        _DWC2_FOOTER.pack(offset, len(manifest), zlib.crc32(manifest), b"DWC2")
+    )
+
+
+def _parse_dwc(data: bytes, path: str) -> Tuple[dict, int]:
+    """(manifest, manifest_offset) from a whole ``.dwc`` byte string.
+
+    Dispatches on the footer tail: ``DWC2`` footers verify the manifest's
+    CRC32 before a single manifest byte is trusted (a flipped shape digit
+    must fail HERE, not as a petabyte ``np.empty``); legacy ``DWCK``
+    footers parse structurally as before.
+    """
+    if len(data) < len(_DWC_MAGIC) + _DWC_FOOTER.size or not data.startswith(
+        _DWC_MAGIC
+    ):
+        raise ValueError(f"{path}: not a DWCK chunked checkpoint")
+    tail = data[-4:]
+    if tail == b"DWC2":
+        man_off, man_len, man_crc, _ = _DWC2_FOOTER.unpack_from(
+            data, len(data) - _DWC2_FOOTER.size
+        )
+        footer_size = _DWC2_FOOTER.size
+    elif tail == b"DWCK":
+        man_off, man_len, man_crc = (
+            *_DWC_FOOTER.unpack_from(data, len(data) - _DWC_FOOTER.size)[:2],
+            None,
+        )
+        footer_size = _DWC_FOOTER.size
+    else:
+        raise ValueError(f"{path}: truncated or corrupt checkpoint footer")
+    if man_off + man_len > len(data) - footer_size:
+        raise ValueError(f"{path}: truncated or corrupt checkpoint footer")
+    man_bytes = data[man_off : man_off + man_len]
+    if man_crc is not None and zlib.crc32(man_bytes) != man_crc:
+        raise ValueError(
+            f"{path}: corrupt checkpoint manifest (CRC mismatch)"
+        )
+    return json.loads(man_bytes), man_off
+
+
+def _entry_chunks(entry: dict) -> Iterator[Tuple[int, int, int, Optional[int]]]:
+    """(offset, comp_len, raw_len, crc_or_None) per chunk — v1 manifests
+    carry 3-element chunk rows (no CRC), v2 carry 4."""
+    for row in entry["chunks"]:
+        off, comp_len, raw_len = row[:3]
+        yield off, comp_len, raw_len, (row[3] if len(row) > 3 else None)
 
 
 def _read_chunked(path: str, target: PyTree) -> PyTree:
     with open(path, "rb") as f:
         data = f.read()
-    if len(data) < len(_DWC_MAGIC) + _DWC_FOOTER.size or not data.startswith(
-        _DWC_MAGIC
-    ):
-        raise ValueError(f"{path}: not a DWCK chunked checkpoint")
-    man_off, man_len, tail = _DWC_FOOTER.unpack_from(
-        data, len(data) - _DWC_FOOTER.size
-    )
-    if tail != b"DWCK" or man_off + man_len > len(data) - _DWC_FOOTER.size:
-        raise ValueError(f"{path}: truncated or corrupt checkpoint footer")
-    manifest = json.loads(data[man_off : man_off + man_len])
+    manifest, man_off = _parse_dwc(data, path)
     flat = {}
     for entry in manifest["leaves"]:
         path_t = tuple(entry["path"])
@@ -255,15 +331,29 @@ def _read_chunked(path: str, target: PyTree) -> PyTree:
         dtype = _dtype(entry["dtype"])
         shape = tuple(entry["shape"])
         nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        # Cross-check the manifest against itself before trusting it with
+        # an allocation: on v1 blobs (no manifest CRC) a corrupt shape or
+        # raw_len must fail as a ValueError, not an absurd np.empty.
+        raw_total = sum(raw for _, _, raw, _ in _entry_chunks(entry))
+        if raw_total != nbytes:
+            raise ValueError(
+                f"{path}: leaf {'/'.join(entry['path'])} manifest is "
+                f"inconsistent ({raw_total} chunk bytes vs {nbytes} from "
+                f"shape) — corrupt manifest"
+            )
         buf = np.empty(nbytes, np.uint8)
         mv = memoryview(buf)
         pos = 0
-        for off, comp_len, raw_len in entry["chunks"]:
+        for off, comp_len, raw_len, crc in _entry_chunks(entry):
             if off + comp_len > man_off:
                 raise ValueError(f"{path}: chunk overruns manifest")
-            n = wire.decompress_into(
-                data[off : off + comp_len], mv[pos : pos + raw_len]
-            )
+            frame = data[off : off + comp_len]
+            if crc is not None and zlib.crc32(frame) != crc:
+                raise ValueError(
+                    f"{path}: corrupt chunk at offset {off} (CRC mismatch) "
+                    f"in leaf {'/'.join(entry['path'])}"
+                )
+            n = wire.decompress_into(frame, mv[pos : pos + raw_len])
             if n != raw_len:
                 raise ValueError(
                     f"{path}: chunk inflated to {n} bytes, manifest says "
@@ -277,6 +367,136 @@ def _read_chunked(path: str, target: PyTree) -> PyTree:
             )
         flat[path_t] = buf.view(dtype).reshape(shape)
     return serialization.from_state_dict(target, _unflatten(flat))
+
+
+def verify_checkpoint(path: str) -> dict:
+    """Integrity-check a checkpoint blob WITHOUT restoring it.
+
+    For v2 chunked blobs this verifies the footer, the manifest CRC, and
+    every chunk frame's CRC — one sequential read, no decompression, no
+    target pytree needed.  v1 chunked blobs get the structural checks only
+    (``verified_chunks`` reports 0 — there is nothing recorded to verify
+    against); monolithic blobs are verified by inflating them (the DWZ1
+    frame is its own integrity check: truncation or corruption fails the
+    inflate).  Raises :data:`CorruptionError` members on corruption;
+    returns a summary dict on success.
+    """
+    if path.endswith(".dwc"):
+        with open(path, "rb") as f:
+            data = f.read()
+        manifest, man_off = _parse_dwc(data, path)
+        checked = 0
+        chunks = 0
+        for entry in manifest["leaves"]:
+            if entry["kind"] != "array":
+                continue
+            # Same manifest self-consistency check as the reader: chunk
+            # raw bytes must add up to the declared shape.
+            nbytes = int(
+                np.prod(tuple(entry["shape"]), dtype=np.int64)
+            ) * _dtype(entry["dtype"]).itemsize
+            raw_total = sum(raw for _, _, raw, _ in _entry_chunks(entry))
+            if raw_total != nbytes:
+                raise ValueError(
+                    f"{path}: leaf {'/'.join(entry['path'])} manifest is "
+                    f"inconsistent ({raw_total} chunk bytes vs {nbytes} "
+                    f"from shape) — corrupt manifest"
+                )
+            for off, comp_len, raw_len, crc in _entry_chunks(entry):
+                if off + comp_len > man_off:
+                    raise ValueError(f"{path}: chunk overruns manifest")
+                chunks += 1
+                if crc is None:
+                    continue
+                if zlib.crc32(data[off : off + comp_len]) != crc:
+                    raise ValueError(
+                        f"{path}: corrupt chunk at offset {off} "
+                        f"(CRC mismatch) in leaf {'/'.join(entry['path'])}"
+                    )
+                checked += 1
+        return {
+            "format": "chunked",
+            "manifest_version": int(manifest.get("version", 1)),
+            "chunks": chunks,
+            "verified_chunks": checked,
+        }
+    with open(path, "rb") as f:
+        blob = wire.decompress(f.read())
+    return {"format": "monolithic", "bytes": len(blob), "verified_chunks": 0}
+
+
+def _footer_ok(path: str) -> bool:
+    """Cheap liveness check for prune: footer + manifest (CRC'd on v2)
+    parse.  Reads only the tail of the file — O(manifest), not O(blob)."""
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            head = f.read(len(_DWC_MAGIC))
+            if head != _DWC_MAGIC:
+                return False
+            f.seek(max(0, size - _DWC2_FOOTER.size))
+            foot = f.read()
+            if foot.endswith(b"DWC2"):
+                man_off, man_len, man_crc, _ = _DWC2_FOOTER.unpack(
+                    foot[-_DWC2_FOOTER.size :]
+                )
+            elif foot.endswith(b"DWCK"):
+                man_off, man_len = _DWC_FOOTER.unpack(
+                    foot[-_DWC_FOOTER.size :]
+                )[:2]
+                man_crc = None
+            else:
+                return False
+            if man_off + man_len > size:
+                return False
+            f.seek(man_off)
+            man_bytes = f.read(man_len)
+        if man_crc is not None and zlib.crc32(man_bytes) != man_crc:
+            return False
+        json.loads(man_bytes)
+        return True
+    except (OSError, *CorruptionError):
+        return False
+
+
+def _step_files_verify(ckpt_dir: str, step: int) -> bool:
+    """Do a step's on-disk files pass integrity verification?
+
+    Gates quarantine: a restore error whose blob AND sidecar verify clean
+    is a *caller* problem (most commonly restoring into a different model
+    config — flax raises the same ValueError shape as corruption), and
+    quarantining would walk every healthy checkpoint into ``*.bad``.
+    Note v1 chunked blobs carry no CRCs, so their payload corruption is
+    unverifiable — they re-raise instead of quarantining, which errs on
+    the side of keeping files.
+    """
+    try:
+        path, _ = checkpoint_path(ckpt_dir, step)
+        verify_checkpoint(path)
+        meta_path = os.path.join(ckpt_dir, f"ckpt_{step}.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                json.load(f)
+        return True
+    except (OSError, *CorruptionError):
+        return False
+
+
+def quarantine_checkpoint(ckpt_dir: str, step: int) -> List[str]:
+    """Rename a corrupt step's blob (and metadata sidecar) to ``*.bad``.
+
+    Quarantined files no longer match the checkpoint patterns: they are
+    invisible to :func:`latest_step`, never count toward ``keep``, and are
+    never re-tried by restore — but the bytes stay on disk as evidence.
+    Returns the renamed paths.
+    """
+    renamed = []
+    for suffix in (*_BLOB_SUFFIXES, ".json"):
+        path = os.path.join(ckpt_dir, f"ckpt_{step}{suffix}")
+        if os.path.exists(path):
+            os.replace(path, path + ".bad")
+            renamed.append(path + ".bad")
+    return renamed
 
 
 # ---------------------------------------------------------------------------
@@ -344,6 +564,13 @@ def save_snapshot(
         if os.path.exists(meta_tmp):
             os.unlink(meta_tmp)
         raise
+    _chaos = _chaos_active()
+    if _chaos is not None:
+        # Fault injection (resilience/chaos.py, inert without DDLPC_CHAOS):
+        # a scheduled disk-full raises HERE, inside the write path proper,
+        # so it surfaces exactly where a real ENOSPC would — through the
+        # AsyncCheckpointer's re-raise-on-training-thread contract.
+        _chaos.on_checkpoint_save()
     fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
@@ -371,7 +598,12 @@ def save_snapshot(
     finally:
         os.close(dir_fd)
     _prune(ckpt_dir, keep)
-    return os.path.join(ckpt_dir, name)
+    final = os.path.join(ckpt_dir, name)
+    if _chaos is not None:
+        # Post-rename bit-flip: corrupts the DURABLE blob, which is the
+        # case the CRC manifest + restore fallback must survive.
+        _chaos.on_checkpoint_written(final)
+    return final
 
 
 def _steps(ckpt_dir: str) -> list[int]:
@@ -385,9 +617,35 @@ def _steps(ckpt_dir: str) -> list[int]:
     return sorted(out)
 
 
+def _newest_verifiable_step(ckpt_dir: str, live: List[int]) -> Optional[int]:
+    """Newest step whose blob passes the cheap footer check — the step a
+    restore would actually land on if the newer ones are corrupt."""
+    for step in reversed(live):
+        try:
+            path, fmt = checkpoint_path(ckpt_dir, step)
+        except FileNotFoundError:
+            continue
+        # Monolithic blobs have no cheap check (inflating the whole blob
+        # per prune is not): treat as verifiable, matching pre-CRC behavior.
+        if fmt != "chunked" or _footer_ok(path):
+            return step
+    return None
+
+
 def _prune(ckpt_dir: str, keep: int) -> None:
     live = _steps(ckpt_dir)
-    for step in live[:-keep] if keep > 0 else []:
+    doomed = live[:-keep] if keep > 0 else []
+    if doomed:
+        # Never delete the newest VERIFIABLE checkpoint: if every blob in
+        # the keep window is corrupt (e.g. a bad disk flipped bits in the
+        # newest writes), the step restore would fall back to must survive
+        # the prune — otherwise ``keep`` compounds corruption into total
+        # loss.  Quarantined ``*.bad`` files never match _CKPT_RE, so they
+        # neither count toward ``keep`` nor shadow a live step here.
+        protect = _newest_verifiable_step(ckpt_dir, live)
+        if protect is not None and protect in doomed:
+            doomed = [s for s in doomed if s != protect]
+    for step in doomed:
         for suffix in (*_BLOB_SUFFIXES, ".json"):
             path = os.path.join(ckpt_dir, f"ckpt_{step}{suffix}")
             if os.path.exists(path):
@@ -395,7 +653,7 @@ def _prune(ckpt_dir: str, keep: int) -> None:
     # Sweep metadata orphaned by a crash between the json and blob renames
     # (save order writes json first) — a .json with no blob is never a
     # restorable step and would otherwise accumulate forever.
-    alive = set(live[-keep:]) if keep > 0 else set(live)
+    alive = set(live) - set(doomed)
     for name in os.listdir(ckpt_dir):
         m = _META_RE.match(name)
         if m and int(m.group(1)) not in alive:
@@ -437,17 +695,7 @@ def peek_metadata(ckpt_dir: str, step: Optional[int] = None) -> dict:
         return json.load(f)
 
 
-def restore_checkpoint(
-    ckpt_dir: str, target: PyTree, step: Optional[int] = None
-) -> Tuple[PyTree, dict]:
-    """Restore (state, metadata).  ``target`` supplies the pytree structure
-    (a freshly-initialized TrainState); ``step=None`` takes the newest.
-    One reader for both formats: the serving engine's hot reload and the
-    predict CLI restore pre-chunked runs through this same dispatch."""
-    if step is None:
-        step = latest_step(ckpt_dir)
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints in {ckpt_dir}")
+def _restore_step(ckpt_dir: str, target: PyTree, step: int) -> Tuple[PyTree, dict]:
     path, fmt = checkpoint_path(ckpt_dir, step)
     if fmt == "chunked":
         state = _read_chunked(path, target)
@@ -460,3 +708,65 @@ def restore_checkpoint(
         with open(meta_path) as f:
             meta = json.load(f)
     return state, meta
+
+
+def restore_checkpoint(
+    ckpt_dir: str,
+    target: PyTree,
+    step: Optional[int] = None,
+    fallback: bool = True,
+) -> Tuple[PyTree, dict]:
+    """Restore (state, metadata).  ``target`` supplies the pytree structure
+    (a freshly-initialized TrainState); ``step=None`` takes the newest.
+    One reader for both formats: the serving engine's hot reload and the
+    predict CLI restore pre-chunked runs through this same dispatch.
+
+    **Integrity fallback** (``fallback=True``, the default): a corrupt or
+    truncated blob is quarantined (renamed ``*.bad`` — never retried,
+    never counted toward ``keep``) with a warning, and the restore moves
+    to the next-newest checkpoint.  Every entry point — trainer resume,
+    serve ``/reload``, the predict CLI — inherits this, so a flipped bit
+    in the newest checkpoint can cost at most one checkpoint interval,
+    never the run.  Only when NOTHING restorable remains does the original
+    corruption error surface.  An explicit ``step=`` restores that step or
+    fails (quarantining it if corrupt) — asking for a specific blob and
+    silently receiving a different one would be worse than the error.
+    """
+    explicit = step is not None
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {ckpt_dir}")
+    quarantined: List[int] = []
+    while True:
+        try:
+            state, meta = _restore_step(ckpt_dir, target, step)
+        except CorruptionError as e:
+            if _step_files_verify(ckpt_dir, step):
+                # The files are intact: this error is the CALLER's
+                # (structure mismatch, wrong target) — falling back would
+                # fail identically on every older checkpoint while
+                # quarantining the whole directory.  Surface it.
+                raise
+            bad = quarantine_checkpoint(ckpt_dir, step)
+            warnings.warn(
+                f"checkpoint step {step} in {ckpt_dir} is corrupt "
+                f"({type(e).__name__}: {e}); quarantined "
+                f"{[os.path.basename(b) for b in bad]}"
+                + ("" if explicit else " — falling back to the next-newest"),
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            quarantined.append(step)
+            nxt = None if explicit or not fallback else latest_step(ckpt_dir)
+            if nxt is None:
+                raise ValueError(
+                    f"checkpoint step {step} is corrupt and no fallback "
+                    f"remains in {ckpt_dir} "
+                    f"(quarantined steps: {quarantined}): {e}"
+                ) from e
+            step = nxt
+            continue
+        if quarantined:
+            meta = dict(meta, quarantined_steps=quarantined)
+        return state, meta

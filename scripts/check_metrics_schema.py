@@ -1,7 +1,8 @@
 """Lint the flat-JSONL telemetry stream contract (ddlpc_tpu/obs/schema.py).
 
 Every JSONL stream a run emits — metrics.jsonl, serve_metrics.jsonl,
-spans.jsonl, serve_spans.jsonl — must be one FLAT JSON object per line
+spans.jsonl, serve_spans.jsonl, resilience.jsonl (the supervisor's
+attempt/give-up stream) — must be one FLAT JSON object per line
 (scalars or lists of scalars) carrying an integer ``schema`` field.  That
 contract is what lets scripts/obs_tail.py tail any stream unchanged and
 lets downstream tooling parse without per-stream special cases; this lint

@@ -1,0 +1,415 @@
+"""Checkpoint integrity (ISSUE 7): per-chunk CRCs in the .dwc manifest,
+corruption detection + quarantine + automatic fallback in the restore
+dispatcher, prune's newest-verified protection, and the fallback behavior
+at every entry point (trainer resume, serve engine restore/reload)."""
+
+import json
+import os
+import struct
+import warnings
+import zlib
+
+import numpy as np
+import pytest
+
+from ddlpc_tpu.train import checkpoint as ckpt
+
+
+def mixed_state(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "w": rng.standard_normal((64, 64)).astype(np.float32),
+        "b": rng.standard_normal(17).astype(np.float32),
+        "step": int(seed),
+    }
+
+
+def target_like(s):
+    return {
+        "w": np.zeros_like(s["w"]),
+        "b": np.zeros_like(s["b"]),
+        "step": 0,
+    }
+
+
+def write_steps(d, steps):
+    for s in steps:
+        ckpt.save_checkpoint(
+            d, mixed_state(s), step=s, metadata={"epoch": s}, keep=10
+        )
+
+
+def _blob(d, step):
+    return os.path.join(d, f"ckpt_{step}.dwc")
+
+
+def flip_payload(path):
+    """Flip a byte inside the first chunk frame (right after the magic)."""
+    with open(path, "r+b") as f:
+        f.seek(12)
+        b = f.read(1)
+        f.seek(12)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def flip_footer(path):
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size - 6)
+        b = f.read(1)
+        f.seek(size - 6)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def flip_manifest(path):
+    """Flip a byte inside the manifest JSON (located via the footer)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    man_off, man_len, _, tail = struct.unpack_from("<QII4s", data, len(data) - 20)
+    assert tail == b"DWC2"
+    pos = man_off + man_len // 2
+    with open(path, "r+b") as f:
+        f.seek(pos)
+        b = f.read(1)
+        f.seek(pos)
+        f.write(bytes([b[0] ^ 0x04]))
+
+
+# ---------------------------------------------------------------------------
+# verify_checkpoint
+
+
+def test_verify_clean_blob(tmp_path):
+    d = str(tmp_path)
+    write_steps(d, [1])
+    rep = ckpt.verify_checkpoint(_blob(d, 1))
+    assert rep["manifest_version"] == 2
+    assert rep["chunks"] == rep["verified_chunks"] > 0
+
+
+@pytest.mark.parametrize(
+    "flip", [flip_payload, flip_footer, flip_manifest],
+    ids=["chunk_payload", "footer", "manifest_json"],
+)
+def test_verify_detects_each_corruption_site(tmp_path, flip):
+    d = str(tmp_path)
+    write_steps(d, [1])
+    flip(_blob(d, 1))
+    with pytest.raises((ValueError, struct.error)):
+        ckpt.verify_checkpoint(_blob(d, 1))
+
+
+# ---------------------------------------------------------------------------
+# the corruption matrix: flip a byte at each site → restore falls back to
+# the previous checkpoint and quarantines the corrupt one (ISSUE 7
+# satellite), never crashing the caller.
+
+
+@pytest.mark.parametrize(
+    "flip", [flip_payload, flip_footer, flip_manifest],
+    ids=["chunk_payload", "footer", "manifest_json"],
+)
+def test_restore_falls_back_and_quarantines(tmp_path, flip):
+    d = str(tmp_path)
+    write_steps(d, [1, 2])
+    flip(_blob(d, 2))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        state, meta = ckpt.restore_checkpoint(d, target_like(mixed_state()))
+    assert meta["step"] == 1
+    assert meta["quarantined_steps"] == [2]
+    assert any("quarantined" in str(x.message) for x in w)
+    np.testing.assert_array_equal(state["w"], mixed_state(1)["w"])
+    # quarantine renamed, not deleted: evidence stays, step 2 is dead
+    names = sorted(os.listdir(d))
+    assert "ckpt_2.dwc.bad" in names and "ckpt_2.json.bad" in names
+    assert "ckpt_2.dwc" not in names
+    assert ckpt.latest_step(d) == 1
+
+
+def test_restore_exhausted_fallbacks_raises(tmp_path):
+    d = str(tmp_path)
+    write_steps(d, [1, 2])
+    flip_payload(_blob(d, 1))
+    flip_payload(_blob(d, 2))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(ValueError, match="no fallback remains"):
+            ckpt.restore_checkpoint(d, target_like(mixed_state()))
+    assert ckpt.latest_step(d) is None  # both quarantined
+
+
+def test_restore_explicit_step_never_silently_substitutes(tmp_path):
+    d = str(tmp_path)
+    write_steps(d, [1, 2])
+    flip_payload(_blob(d, 2))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(ValueError, match="corrupt"):
+            ckpt.restore_checkpoint(d, target_like(mixed_state()), step=2)
+    # the corrupt blob is still quarantined; the good step is untouched
+    assert ckpt.latest_step(d) == 1
+
+
+def test_fallback_skips_two_corrupt_steps(tmp_path):
+    d = str(tmp_path)
+    write_steps(d, [1, 2, 3])
+    flip_payload(_blob(d, 3))
+    flip_manifest(_blob(d, 2))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        state, meta = ckpt.restore_checkpoint(d, target_like(mixed_state()))
+    assert meta["step"] == 1
+    assert meta["quarantined_steps"] == [3, 2]
+    np.testing.assert_array_equal(state["b"], mixed_state(1)["b"])
+
+
+def test_truncation_is_detected_and_falls_back(tmp_path):
+    d = str(tmp_path)
+    write_steps(d, [1, 2])
+    p = _blob(d, 2)
+    blob = open(p, "rb").read()
+    open(p, "wb").write(blob[: len(blob) // 3])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        _, meta = ckpt.restore_checkpoint(d, target_like(mixed_state()))
+    assert meta["step"] == 1
+
+
+def test_structure_mismatch_never_quarantines(tmp_path):
+    """Restoring into a DIFFERENT target structure (changed model config)
+    raises the caller's error but must not quarantine the healthy blobs —
+    otherwise the fallback loop walks the whole directory into *.bad."""
+    d = str(tmp_path)
+    write_steps(d, [1, 2])
+    # a target key the blob doesn't carry — flax raises the same
+    # ValueError shape as corruption would
+    wrong_target = dict(target_like(mixed_state()), extra=np.zeros(3))
+    with pytest.raises(ValueError, match="do not match"):
+        ckpt.restore_checkpoint(d, wrong_target)
+    assert ckpt._steps(d) == [1, 2]  # both checkpoints untouched
+    assert not [n for n in os.listdir(d) if n.endswith(".bad")]
+
+
+# ---------------------------------------------------------------------------
+# prune rules (ISSUE 7 satellite)
+
+
+def test_prune_never_removes_newest_verified(tmp_path):
+    """keep=1 with a corrupt newest blob: the newest VERIFIABLE checkpoint
+    survives the prune — otherwise keep would compound corruption into
+    total loss."""
+    d = str(tmp_path)
+    write_steps(d, [1, 2])
+    flip_payload(_blob(d, 2))  # newest is now corrupt (footer still parses
+    # — but the full restore would fail; the cheap check is the footer, so
+    # corrupt the footer to make the check see it)
+    flip_footer(_blob(d, 2))
+    ckpt.save_checkpoint(d, mixed_state(3), step=3, keep=1)
+    # keep=1 would normally leave only step 3; step 2's footer fails the
+    # cheap verify, so the newest verifiable among the doomed... step 3 is
+    # fresh and verifiable — steps 1 and 2 can go.
+    assert ckpt._steps(d) == [3]
+
+    # Now corrupt the NEWEST and prune again via another save with keep=1:
+    flip_footer(_blob(d, 3))
+    ckpt.save_checkpoint(d, mixed_state(4), step=4, keep=1)
+    live = ckpt._steps(d)
+    assert 4 in live and 3 not in live  # 3 is corrupt AND outside keep
+
+
+def test_prune_protects_older_verified_when_kept_window_is_corrupt(tmp_path):
+    d = str(tmp_path)
+    write_steps(d, [1, 2, 3])
+    flip_footer(_blob(d, 3))
+    flip_footer(_blob(d, 2))
+    # keep=2 would delete step 1 — but 1 is the newest verifiable blob.
+    ckpt._prune(d, keep=2)
+    live = ckpt._steps(d)
+    assert 1 in live, live
+    # and its metadata sidecar survived with it
+    assert os.path.exists(os.path.join(d, "ckpt_1.json"))
+
+
+def test_quarantined_blobs_do_not_count_toward_keep(tmp_path):
+    d = str(tmp_path)
+    write_steps(d, [1, 2, 3])
+    flip_payload(_blob(d, 3))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ckpt.restore_checkpoint(d, target_like(mixed_state()))  # quarantines 3
+    # keep=2 now counts only live steps {1, 2}: both stay.
+    ckpt.save_checkpoint(d, mixed_state(4), step=4, keep=2)
+    # live steps were {1, 2, 4}: the quarantined 3 is invisible, keep=2
+    # retains {2, 4} — NOT {4} as it would if .bad still counted.
+    assert ckpt._steps(d) == [2, 4]
+    assert os.path.exists(os.path.join(d, "ckpt_3.dwc.bad"))
+
+
+def test_v2_manifest_rejects_absurd_allocation_before_empty(tmp_path):
+    """A corrupt manifest must fail as a ValueError BEFORE np.empty gets
+    asked for a fantasy allocation — the manifest CRC catches any flip."""
+    d = str(tmp_path)
+    write_steps(d, [1])
+    p = _blob(d, 1)
+    with open(p, "rb") as f:
+        data = f.read()
+    man_off, man_len, _, _ = struct.unpack_from("<QII4s", data, len(data) - 20)
+    manifest = json.loads(data[man_off : man_off + man_len])
+    # forge a huge shape WITH a recomputed manifest CRC (so only the
+    # raw-total-vs-shape cross-check can catch it)
+    for leaf in manifest["leaves"]:
+        if leaf["kind"] == "array":
+            leaf["shape"] = [1 << 40]
+            break
+    forged = json.dumps(manifest).encode()
+    new = data[:man_off] + forged + struct.pack(
+        "<QII4s", man_off, len(forged), zlib.crc32(forged), b"DWC2"
+    )
+    with open(p, "wb") as f:
+        f.write(new)
+    with pytest.raises(ValueError, match="inconsistent|corrupt"):
+        ckpt._read_chunked(p, target_like(mixed_state()))
+
+
+# ---------------------------------------------------------------------------
+# entry points: serve engine restore + reload fall back too (the trainer
+# entry point is covered in tests/test_preemption.py with a real Trainer)
+
+
+TILE = 32
+
+
+def _tiny_run(workdir, steps=(1, 2)):
+    from scripts.serve_bench import make_tiny_run
+
+    for i, s in enumerate(steps):
+        make_tiny_run(workdir, tile=TILE, num_classes=4, seed=i, step=s)
+    return workdir
+
+
+def test_engine_from_workdir_falls_back_on_corrupt_newest(tmp_path):
+    from ddlpc_tpu.serve.engine import InferenceEngine
+
+    d = _tiny_run(str(tmp_path / "run"))
+    flip_payload(os.path.join(d, "checkpoints", "ckpt_2.dwc"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        eng = InferenceEngine.from_workdir(d, echo=False)
+    assert eng.checkpoint_step == 1
+    out = eng.forward_windows(np.zeros((1, TILE, TILE, 3), np.float32))
+    assert out.shape == (1, TILE, TILE, 4)
+
+
+def test_engine_cold_start_survives_corrupt_newest_sidecar(tmp_path):
+    """Bit rot in ckpt_N.json (blob intact elsewhere): cold start must not
+    abort on the metadata peek — the restore dispatcher quarantines the
+    whole step and falls back."""
+    from ddlpc_tpu.serve.engine import InferenceEngine
+
+    d = _tiny_run(str(tmp_path / "run"))
+    meta_path = os.path.join(d, "checkpoints", "ckpt_2.json")
+    with open(meta_path, "r+b") as f:
+        f.write(b"\x00garbage")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        eng = InferenceEngine.from_workdir(d, echo=False)
+    assert eng.checkpoint_step == 1
+    out = eng.forward_windows(np.zeros((1, TILE, TILE, 3), np.float32))
+    assert out.shape == (1, TILE, TILE, 4)
+
+
+def test_frontend_reload_survives_total_corruption(tmp_path):
+    """Serve /reload with EVERY checkpoint corrupt: structured error, old
+    weights keep serving, alert counter incremented — no exception to the
+    HTTP handler (ISSUE 7 satellite)."""
+    from ddlpc_tpu.serve.engine import InferenceEngine
+    from ddlpc_tpu.serve.server import ServingFrontend
+    from ddlpc_tpu.config import ServeConfig
+
+    d = _tiny_run(str(tmp_path / "run"))
+    eng = InferenceEngine.from_workdir(d, echo=False)
+    fe = ServingFrontend(
+        eng, ServeConfig(workdir=d, metrics_every_s=0, max_wait_ms=1.0)
+    )
+    try:
+        before_version = eng.version
+        before_pred = fe.predict_classes(
+            np.zeros((TILE, TILE, 3), np.float32)
+        )
+        ckdir = os.path.join(d, "checkpoints")
+        for name in list(os.listdir(ckdir)):
+            if name.endswith(".dwc"):
+                flip_payload(os.path.join(ckdir, name))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            meta = fe.reload()
+        assert "error" in meta
+        assert meta["version"] == before_version  # still the old weights
+        assert fe.last_reload_error is not None
+        assert fe._reload_errors.value(error="ValueError") == 1.0
+        assert any(a["alert"] == "reload_failed" for a in fe.health.alerts)
+        assert fe.healthz()["last_reload_error"] is not None
+        # ... and predictions still serve, unchanged
+        after_pred = fe.predict_classes(
+            np.zeros((TILE, TILE, 3), np.float32)
+        )
+        np.testing.assert_array_equal(before_pred, after_pred)
+    finally:
+        fe.close(drain=False)
+
+
+def test_predict_cli_falls_back_on_corrupt_newest(tmp_path):
+    """Third entry point (acceptance): the predict CLI's restore — through
+    the same engine ``from_workdir`` — survives a corrupt newest blob and
+    writes predictions from the fallback checkpoint."""
+    pytest.importorskip("PIL")
+    from PIL import Image
+
+    from ddlpc_tpu import predict
+
+    d = _tiny_run(str(tmp_path / "run"))
+    flip_payload(os.path.join(d, "checkpoints", "ckpt_2.dwc"))
+    img_dir = str(tmp_path / "imgs")
+    os.makedirs(img_dir)
+    Image.fromarray(
+        np.zeros((TILE, TILE, 3), np.uint8)
+    ).save(os.path.join(img_dir, "a.png"))
+    out_dir = str(tmp_path / "out")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rc = predict.main(
+            ["--workdir", d, "--input", img_dir, "--output", out_dir]
+        )
+    assert rc == 0
+    assert os.path.exists(os.path.join(out_dir, "a_pred.png"))
+    assert os.path.exists(os.path.join(d, "checkpoints", "ckpt_2.dwc.bad"))
+
+
+def test_frontend_reload_fallback_reports_quarantine(tmp_path):
+    from ddlpc_tpu.serve.engine import InferenceEngine
+    from ddlpc_tpu.serve.server import ServingFrontend
+    from ddlpc_tpu.config import ServeConfig
+
+    d = _tiny_run(str(tmp_path / "run"), steps=(1,))
+    eng = InferenceEngine.from_workdir(d, echo=False)
+    fe = ServingFrontend(
+        eng, ServeConfig(workdir=d, metrics_every_s=0, max_wait_ms=1.0)
+    )
+    try:
+        # a NEWER but corrupt checkpoint appears, then /reload
+        from scripts.serve_bench import make_tiny_run
+
+        make_tiny_run(d, tile=TILE, num_classes=4, seed=9, step=5)
+        flip_payload(os.path.join(d, "checkpoints", "ckpt_5.dwc"))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            meta = fe.reload()
+        assert "error" not in meta
+        assert meta["step"] == 1  # fell back
+        assert meta["quarantined_steps"] == [5]
+        assert any(
+            a["alert"] == "checkpoint_quarantined" for a in fe.health.alerts
+        )
+    finally:
+        fe.close(drain=False)

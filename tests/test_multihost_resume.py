@@ -93,8 +93,8 @@ def test_process0_broadcasts_found_epoch_and_state(
     bcast = RecordingBroadcast()
     _patch_topology(monkeypatch, count=2, index=0, bcast=bcast)
     resumed._restore_synchronized()
-    # Broadcast #1: the (found, next_epoch) decision flags.
-    np.testing.assert_array_equal(bcast.calls[0], np.array([1, 4], np.int32))
+    # Broadcast #1: the (found, next_epoch, mid_epoch_skip) decision flags.
+    np.testing.assert_array_equal(bcast.calls[0], np.array([1, 4, 0], np.int32))
     # Broadcast #2: the restored state pytree (params included).
     assert len(bcast.calls) == 2
     assert resumed.start_epoch == 4
@@ -118,12 +118,12 @@ def test_nonzero_process_applies_broadcast_not_local_disk(
         os.path.join(workdir, "checkpoints"), resumed.state
     )
     bcast = RecordingBroadcast(
-        scripted=[np.array([1, 4], np.int32), state0]
+        scripted=[np.array([1, 4, 0], np.int32), state0]
     )
     _patch_topology(monkeypatch, count=2, index=1, bcast=bcast)
     resumed._restore_synchronized()
     # It contributed its own (not-found) flags, then took process 0's state.
-    np.testing.assert_array_equal(bcast.calls[0], np.array([0, 0], np.int32))
+    np.testing.assert_array_equal(bcast.calls[0], np.array([0, 0, 0], np.int32))
     assert resumed.start_epoch == 4
     for a, b in zip(
         jax.tree.leaves(resumed.state.params),
@@ -157,6 +157,6 @@ def test_epochless_metadata_still_restores_weights(
     bcast = RecordingBroadcast()
     _patch_topology(monkeypatch, count=2, index=0, bcast=bcast)
     resumed._restore_synchronized()
-    np.testing.assert_array_equal(bcast.calls[0], np.array([1, 0], np.int32))
+    np.testing.assert_array_equal(bcast.calls[0], np.array([1, 0, 0], np.int32))
     assert resumed.start_epoch == 0
     assert len(bcast.calls) == 2

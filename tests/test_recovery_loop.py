@@ -1,13 +1,12 @@
 """The full failure-recovery loop: detect → abort(42) → restart → resume.
 
-VERDICT r2 weak #5: the watchdog's mechanics were tested in isolation but
-nothing exercised the actual recovery story the docstring promises
-(train/watchdog.py): a stalled run aborts with the distinctive exit status,
-a supervisor restarts the process, and the restart resumes from the latest
-checkpoint and continues the epoch count.  This test IS that supervisor:
-it launches a real training process with an injected epoch-1 hang, asserts
-the watchdog kills it with status 42, relaunches, and asserts the second
-process resumes at epoch 1 and finishes the run.
+VERDICT r2 weak #5 wanted the recovery story exercised end-to-end; ISSUE 7
+promoted the supervisor from this test's private re-implementation into
+``ddlpc_tpu.resilience.supervisor`` — so the test now drives the SHIPPED
+code path: a real training process with an injected epoch-1 hang, the
+watchdog turning the unbounded hang into exit status 42, the supervisor
+classifying it and relaunching, and the restart resuming at epoch 1 and
+finishing the run.
 
 The reference, for contrast, hangs forever on a dead peer
 (кластер.py:215-220) and has no checkpoint to come back to (SURVEY §5).
@@ -15,10 +14,12 @@ The reference, for contrast, hangs forever on a dead peer
 
 import json
 import os
-import subprocess
 import sys
 
 import pytest
+
+from ddlpc_tpu.resilience.protocol import EXIT_STALL
+from ddlpc_tpu.resilience.supervisor import Supervisor
 
 REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
@@ -45,6 +46,7 @@ cfg = ExperimentConfig(
         epochs=3, micro_batch_size=1, sync_period=2,
         dump_images_per_epoch=0, checkpoint_every_epochs=1,
         eval_every_epochs=0, stall_timeout_s=60.0, stall_action="abort",
+        checkpoint_async=False,
     ),
     workdir={workdir!r},
 )
@@ -63,42 +65,49 @@ print("RUN_DONE", flush=True)
 
 
 @pytest.mark.slow  # two subprocess trainings + compiles (~2 min); the
-# pieces stay tier-1: watchdog arming (test_watchdog), resume
-# (test_trainer), crash atomicity (test_checkpoint_format)
+# pieces stay tier-1: watchdog arming (test_watchdog), supervisor logic
+# with fake processes (test_resilience), fast kill-chaos recovery
+# (test_preemption), crash atomicity (test_checkpoint_format)
 def test_stall_abort_restart_resume(tmp_path):
     workdir = str(tmp_path / "run")
     script = CHILD.format(repo_root=REPO_ROOT, workdir=workdir)
-    env = dict(os.environ, INJECT_STALL="1")
 
-    # Run 1: trains epoch 0 (checkpointing it), hangs in epoch 1; the
-    # watchdog must turn the unbounded hang into exit status 42.
-    p1 = subprocess.run(
+    def env_fn(attempt):
+        # Attempt 0 hangs in epoch 1; every restart runs stall-free — the
+        # per-attempt env rewrite is the supervisor's knob for exactly this.
+        return dict(os.environ, INJECT_STALL="1" if attempt == 0 else "0")
+
+    sup = Supervisor(
         [sys.executable, "-c", script],
-        env=env,
-        capture_output=True,
-        text=True,
-        timeout=600,
+        workdir=workdir,
+        env_fn=env_fn,
+        crash_loop_limit=2,
+        backoff_base_s=0.01,
+        echo=False,
     )
-    assert p1.returncode == 42, (p1.returncode, p1.stdout[-2000:], p1.stderr[-2000:])
-    assert "START_EPOCH 0" in p1.stdout
-    assert "RUN_DONE" not in p1.stdout
+    result = sup.run()
+
+    # Run 1 trained + checkpointed epoch 0, hung in epoch 1, and the
+    # watchdog turned the hang into the distinctive status the supervisor
+    # classifies as a stall; run 2 resumed past epoch 0 and finished.
+    assert result.ok, (result.final_status, result.reason)
+    assert result.attempts == 2
+    assert result.restarts_by_cause == {"stall": 1}
+
     stall_log = os.path.join(workdir, "stall.log")
     assert os.path.exists(stall_log)
     assert "no heartbeat" in open(stall_log).read()
 
-    # Run 2 (the supervisor's restart): must resume past the completed
-    # epoch 0 and finish the remaining epochs cleanly.
-    env["INJECT_STALL"] = "0"
-    p2 = subprocess.run(
-        [sys.executable, "-c", script],
-        env=env,
-        capture_output=True,
-        text=True,
-        timeout=600,
-    )
-    assert p2.returncode == 0, (p2.returncode, p2.stdout[-2000:], p2.stderr[-2000:])
-    assert "START_EPOCH 1" in p2.stdout
-    assert "RUN_DONE" in p2.stdout
+    # The supervisor's stream recorded the 42 and the progress-aware
+    # classification (epoch 0's checkpoint existed → no backoff counted).
+    sup_records = [
+        json.loads(l)
+        for l in open(os.path.join(workdir, "resilience.jsonl"))
+    ]
+    attempts = [r for r in sup_records if r["kind"] == "supervisor_attempt"]
+    assert [a["cause"] for a in attempts] == ["stall", "clean"]
+    assert attempts[0]["rc"] == EXIT_STALL
+    assert attempts[0]["progressed"] is True
 
     # The combined record shows a continuous epoch count: 0 from run 1,
     # then 1 and 2 from the resumed run — no epoch repeated or skipped.

@@ -1,0 +1,454 @@
+"""resilience subsystem units: exit classification, breadcrumbs, chaos
+spec parsing/injection, and the supervisor's backoff / crash-loop /
+restart-accounting logic with a fake clock and no real processes
+(ISSUE 7 tentpole + satellite: supervisor backoff/crash-loop unit tests)."""
+
+import json
+import os
+import signal
+
+import pytest
+
+from ddlpc_tpu.resilience import chaos
+from ddlpc_tpu.resilience.protocol import (
+    EXIT_CLEAN,
+    EXIT_PREEMPTED,
+    EXIT_STALL,
+    latest_checkpoint_step,
+    read_breadcrumb,
+    write_breadcrumb,
+)
+from ddlpc_tpu.resilience.supervisor import (
+    Supervisor,
+    classify_exit,
+)
+
+
+# ---------------------------------------------------------------------------
+# protocol
+
+
+def test_classify_exit_matrix():
+    assert classify_exit(EXIT_CLEAN) == "clean"
+    assert classify_exit(EXIT_STALL) == "stall"
+    assert classify_exit(EXIT_PREEMPTED) == "preempted"
+    assert classify_exit(-signal.SIGKILL) == "oom_kill"
+    assert classify_exit(128 + signal.SIGKILL) == "oom_kill"
+    assert classify_exit(-signal.SIGTERM) == "signal"
+    assert classify_exit(1) == "crash"
+    assert classify_exit(77) == "crash"
+
+
+def test_classify_exit_breadcrumb_refines():
+    # A crash-status exit whose crumb says the graceful path ran is a
+    # preemption (the grace window hard-exit writes preempt_timeout).
+    assert classify_exit(1, {"phase": "preempted"}) == "preempted"
+    assert classify_exit(-9, {"phase": "preempt_timeout"}) == "preempted"
+    assert classify_exit(1, {"phase": "stalled"}) == "stall"
+    # clean is clean no matter what the crumb says
+    assert classify_exit(0, {"phase": "running"}) == "clean"
+
+
+def test_breadcrumb_roundtrip(tmp_path):
+    d = str(tmp_path)
+    assert read_breadcrumb(d) is None
+    write_breadcrumb(d, "running", epoch=3, last_ckpt_step=17)
+    crumb = read_breadcrumb(d)
+    assert crumb["phase"] == "running"
+    assert crumb["epoch"] == 3
+    assert crumb["last_ckpt_step"] == 17
+    assert crumb["pid"] == os.getpid()
+    write_breadcrumb(d, "done")
+    assert read_breadcrumb(d)["phase"] == "done"
+    # torn/unreadable file degrades to None, never raises
+    with open(os.path.join(d, "breadcrumb.json"), "w") as f:
+        f.write('{"phase": "runn')
+    assert read_breadcrumb(d) is None
+
+
+def test_latest_checkpoint_step_ignores_quarantine(tmp_path):
+    d = str(tmp_path)
+    assert latest_checkpoint_step(d) is None
+    for name in ("ckpt_3.dwc", "ckpt_7.msgpack.z", "ckpt_9.dwc.bad",
+                 "ckpt_9.json.bad", "ckpt_5.json", "junk.txt"):
+        open(os.path.join(d, name), "w").close()
+    # 9 is quarantined, 5 has no blob: newest LIVE step is 7.
+    assert latest_checkpoint_step(d) == 7
+
+
+# ---------------------------------------------------------------------------
+# chaos
+
+
+def test_chaos_spec_parsing():
+    m = chaos.ChaosMonkey("kill@7; stall@9:120 ;nan@3;flip_ckpt@2;"
+                          "disk_full@1;slow_loader:50")
+    assert 7 in m.step_faults and 9 in m.step_faults and 3 in m.step_faults
+    assert m.step_faults[9][0]["dur"] == 120.0
+    assert m.ckpt_faults == {"flip_ckpt": 2, "disk_full": 1}
+    assert m.slow_loader_ms == 50.0
+
+
+@pytest.mark.parametrize("bad", ["explode@3", "kill@x", "kill", "stall@2:abc",
+                                 "slow_loader"])
+def test_chaos_spec_errors_are_loud(bad):
+    with pytest.raises(chaos.ChaosError):
+        chaos.ChaosMonkey(bad)
+
+
+def test_chaos_nan_arms_once():
+    m = chaos.ChaosMonkey("nan@2")
+    assert m.on_step(1) == set()
+    assert m.on_step(2) == set()  # nan arms internally, no action returned
+    rec = m.corrupt_record({"epoch": 0, "loss": 1.25})
+    assert rec["loss"] != rec["loss"]  # NaN
+    # one-shot: later records pass through untouched
+    rec2 = m.corrupt_record({"epoch": 1, "loss": 0.5})
+    assert rec2["loss"] == 0.5
+    assert m.on_step(2) == set()  # fault consumed
+
+
+def test_chaos_preempt_returned_as_action():
+    m = chaos.ChaosMonkey("preempt@4")
+    assert m.on_step(3) == set()
+    assert m.on_step(4) == {"preempt"}
+    assert m.on_step(4) == set()
+
+
+def test_chaos_disk_full_on_nth_write():
+    m = chaos.ChaosMonkey("disk_full@2")
+    m.on_checkpoint_save()  # write 1: fine
+    with pytest.raises(OSError):
+        m.on_checkpoint_save()  # write 2: ENOSPC
+    m.on_checkpoint_save()  # write 3: consumed, fine
+
+
+def test_chaos_flip_ckpt_flips_one_byte(tmp_path):
+    p = str(tmp_path / "blob.dwc")
+    payload = bytes(range(256)) * 8
+    with open(p, "wb") as f:
+        f.write(payload)
+    m = chaos.ChaosMonkey("flip_ckpt@1")
+    m.on_checkpoint_save()
+    m.on_checkpoint_written(p)
+    after = open(p, "rb").read()
+    assert len(after) == len(payload)
+    diffs = [i for i, (a, b) in enumerate(zip(payload, after)) if a != b]
+    assert diffs == [len(payload) // 2]
+    assert m.fired[-1]["kind"] == "flip_ckpt"
+
+
+def test_chaos_active_caches_per_spec(monkeypatch):
+    monkeypatch.delenv(chaos.ENV, raising=False)
+    assert chaos.active() is None
+    monkeypatch.setenv(chaos.ENV, "kill@5")
+    m1 = chaos.active()
+    assert m1 is chaos.active()  # firing state persists across call sites
+    monkeypatch.setenv(chaos.ENV, "kill@6")
+    m2 = chaos.active()
+    assert m2 is not m1  # new spec, fresh schedule
+    monkeypatch.delenv(chaos.ENV, raising=False)
+    assert chaos.active() is None
+
+
+# ---------------------------------------------------------------------------
+# supervisor (fake processes + fake clock)
+
+
+class FakeChild:
+    def __init__(self, rc):
+        self._rc = rc
+        self.returncode = None
+        # Side-effect breadcrumbs are written by THIS test process, so the
+        # supervisor's stale-crumb pid guard must see a matching child pid.
+        self.pid = os.getpid()
+
+    def wait(self):
+        self.returncode = self._rc
+        return self._rc
+
+    def poll(self):
+        return self.returncode
+
+    def send_signal(self, sig):
+        pass
+
+
+class Script:
+    """Fake Popen: each launch pops (side_effect, rc); side effects mutate
+    the fake run dir (write a checkpoint = progress, a breadcrumb, ...)."""
+
+    def __init__(self, steps):
+        self.steps = list(steps)
+        self.launches = 0
+
+    def __call__(self, cmd, env=None):
+        side, rc = self.steps.pop(0)
+        self.launches += 1
+        if side is not None:
+            side()
+        return FakeChild(rc)
+
+
+class FakeRng:
+    """uniform(0, x) -> x: backoff asserts see the ceiling exactly."""
+
+    def uniform(self, a, b):
+        return b
+
+
+def _touch_ckpt(workdir, step):
+    d = os.path.join(workdir, "checkpoints")
+    os.makedirs(d, exist_ok=True)
+    open(os.path.join(d, f"ckpt_{step}.dwc"), "w").close()
+
+
+def make_sup(tmp_path, script, **kw):
+    sleeps = []
+    kw.setdefault("backoff_base_s", 1.0)
+    kw.setdefault("backoff_cap_s", 60.0)
+    sup = Supervisor(
+        ["fake-train"],
+        workdir=str(tmp_path),
+        popen=script,
+        sleep=sleeps.append,
+        rng=FakeRng(),
+        echo=False,
+        **kw,
+    )
+    return sup, sleeps
+
+
+def test_supervisor_clean_first_try(tmp_path):
+    script = Script([(None, 0)])
+    sup, sleeps = make_sup(tmp_path, script)
+    res = sup.run()
+    assert res.ok and res.attempts == 1 and res.restarts_by_cause == {}
+    assert sleeps == []
+
+
+def test_supervisor_stall_restart_resume(tmp_path):
+    wd = str(tmp_path)
+    script = Script([
+        (lambda: _touch_ckpt(wd, 5), EXIT_STALL),  # progressed, then stalled
+        (None, 0),
+    ])
+    sup, sleeps = make_sup(tmp_path, script)
+    res = sup.run()
+    assert res.ok and res.attempts == 2
+    assert res.restarts_by_cause == {"stall": 1}
+    assert sleeps == []  # progress → no backoff
+    # restart counter reached the registry
+    text = sup.registry.exposition()
+    assert 'ddlpc_restarts_total{cause="stall"} 1' in text
+
+
+def test_supervisor_preempted_restarts_without_backoff(tmp_path):
+    wd = str(tmp_path)
+    script = Script([
+        (lambda: write_breadcrumb(wd, "preempted"), EXIT_PREEMPTED),
+        (None, 0),
+    ])
+    sup, sleeps = make_sup(tmp_path, script)
+    res = sup.run()
+    assert res.ok and res.restarts_by_cause == {"preempted": 1}
+    assert sleeps == []
+
+
+def test_supervisor_backoff_grows_exponentially(tmp_path):
+    wd = str(tmp_path)
+    script = Script([
+        (lambda: _touch_ckpt(wd, 1), 1),  # progress resets nothing yet (first)
+        (None, 1),  # no progress: streak 1
+        (None, 1),  # no progress: streak 2
+        (None, 0),
+    ])
+    sup, sleeps = make_sup(tmp_path, script, crash_loop_limit=10)
+    res = sup.run()
+    assert res.ok and res.attempts == 4
+    # FakeRng returns the jitter ceiling: base·2^(streak-1) capped.
+    assert sleeps == [1.0, 2.0]
+
+
+def test_supervisor_backoff_caps(tmp_path):
+    sup, _ = make_sup(tmp_path, Script([]), backoff_base_s=4.0,
+                      backoff_cap_s=10.0)
+    assert sup.backoff_s(0) == 0.0
+    assert sup.backoff_s(1) == 4.0
+    assert sup.backoff_s(2) == 8.0
+    assert sup.backoff_s(3) == 10.0  # capped
+    assert sup.backoff_s(30) == 10.0
+
+
+def test_supervisor_crash_loop_gives_up_loudly(tmp_path):
+    wd = str(tmp_path)
+    script = Script([(None, 1)] * 5 + [(None, 0)])
+    sup, _ = make_sup(tmp_path, script, crash_loop_limit=3)
+    res = sup.run()
+    assert res.gave_up and not res.ok
+    assert res.attempts == 3 and script.launches == 3  # never launched #4
+    assert "crash loop" in res.reason
+    # the give-up is a critical record in the resilience stream
+    records = [json.loads(l) for l in open(os.path.join(wd, "resilience.jsonl"))]
+    kinds = [r["kind"] for r in records]
+    assert kinds.count("supervisor_attempt") == 3
+    assert kinds[-1] == "supervisor_give_up"
+    assert records[-1]["severity"] == "critical"
+
+
+def test_supervisor_progress_resets_crash_loop(tmp_path):
+    wd = str(tmp_path)
+    script = Script([
+        (None, 1),                         # streak 1
+        (None, 1),                         # streak 2
+        (lambda: _touch_ckpt(wd, 2), 1),   # progressed → streak resets
+        (None, 1),                         # streak 1
+        (None, 0),
+    ])
+    sup, _ = make_sup(tmp_path, script, crash_loop_limit=3)
+    res = sup.run()
+    assert res.ok and res.attempts == 5
+
+
+def test_supervisor_max_restarts_budget(tmp_path):
+    wd = str(tmp_path)
+    steps = []
+    for i in range(10):
+        steps.append((lambda i=i: _touch_ckpt(wd, i), EXIT_STALL))
+    script = Script(steps)
+    sup, _ = make_sup(tmp_path, script, max_restarts=4, crash_loop_limit=99)
+    res = sup.run()
+    assert res.gave_up and "budget" in res.reason
+    assert script.launches == 5  # initial + 4 restarts
+
+
+def test_supervisor_stop_ends_supervision(tmp_path):
+    sup_holder = {}
+
+    def preempt_side():
+        # The operator SIGTERMs the supervisor while the child runs: the
+        # child exits preempted and no relaunch happens.
+        sup_holder["sup"].request_stop()
+
+    script = Script([(preempt_side, EXIT_PREEMPTED), (None, 0)])
+    sup, _ = make_sup(tmp_path, script)
+    sup_holder["sup"] = sup
+    res = sup.run()
+    assert res.final_status == EXIT_PREEMPTED
+    assert script.launches == 1
+    assert res.reason == "stopped by signal"
+
+
+def test_supervisor_stale_breadcrumb_does_not_mask_crash_loop(tmp_path):
+    """A crumb left by a previous attempt must not classify a later crash:
+    attempt 0 preempts gracefully (crumb phase=preempted), then every
+    relaunch dies before writing anything — the crashes must trip the
+    crash-loop limit, not read as endless clean preemptions."""
+    wd = str(tmp_path)
+
+    class StalePidChild(FakeChild):
+        def __init__(self, rc):
+            super().__init__(rc)
+            self.pid = os.getpid() + 1  # crumb pid never matches
+
+    class StaleScript(Script):
+        def __call__(self, cmd, env=None):
+            side, rc = self.steps.pop(0)
+            self.launches += 1
+            if side is not None:
+                side()
+            return StalePidChild(rc)
+
+    write_breadcrumb(wd, "preempted")  # attempt -1's leftover
+    script = StaleScript([(None, 1), (None, 1), (None, 1)])
+    sup, _ = make_sup(tmp_path, script, crash_loop_limit=3)
+    res = sup.run()
+    assert res.gave_up
+    assert script.launches == 3
+    assert res.restarts_by_cause.get("crash", 0) >= 1
+    assert "preempted" not in res.restarts_by_cause
+
+
+def test_supervisor_preempt_timeout_counts_toward_crash_loop(tmp_path):
+    """A 43 whose grace window expired (phase=preempt_timeout, no
+    checkpoint progress — e.g. a dead checkpoint store) must keep
+    counting toward backoff and give-up, not reset the streak."""
+    wd = str(tmp_path)
+    side = lambda: write_breadcrumb(wd, "preempt_timeout")  # noqa: E731
+    script = Script([(side, EXIT_PREEMPTED)] * 3)
+    sup, sleeps = make_sup(tmp_path, script, crash_loop_limit=3)
+    res = sup.run()
+    assert res.gave_up
+    assert script.launches == 3
+    assert len(sleeps) > 0  # non-progressing preemptions back off
+
+
+def test_supervisor_stream_passes_schema_lint(tmp_path):
+    """Satellite: scripts/check_metrics_schema.py covers resilience.jsonl."""
+    wd = str(tmp_path)
+    script = Script([
+        (lambda: _touch_ckpt(wd, 1), EXIT_STALL),
+        (None, 1),
+        (None, 0),
+    ])
+    sup, _ = make_sup(tmp_path, script, crash_loop_limit=5)
+    assert sup.run().ok
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics_schema",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "check_metrics_schema.py"),
+    )
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    violations = lint.lint_file(os.path.join(wd, "resilience.jsonl"))
+    assert violations == [], violations
+
+
+def test_supervisor_env_fn_varies_attempts(tmp_path):
+    seen = []
+
+    class EnvScript(Script):
+        def __call__(self, cmd, env=None):
+            seen.append(env)
+            return super().__call__(cmd, env)
+
+    wd = str(tmp_path)
+    script = EnvScript([
+        (lambda: _touch_ckpt(wd, 1), EXIT_STALL),
+        (None, 0),
+    ])
+    sup, _ = make_sup(tmp_path, script)
+    sup.env_fn = lambda attempt: {"ATTEMPT": str(attempt)}
+    assert sup.run().ok
+    assert seen == [{"ATTEMPT": "0"}, {"ATTEMPT": "1"}]
+
+
+@pytest.mark.slow  # control run + ~7 supervised subprocess attempts, each
+# paying a jax import/compile (several minutes); the fast slice stays
+# tier-1 (test_preemption.py::test_chaos_kill_supervised_resume)
+def test_full_chaos_soak_survives(tmp_path):
+    """The whole story at once (scripts/chaos_soak.py --quick): supervised
+    training under the full fault schedule — kill, stall, corrupt
+    checkpoint, disk-full, preemption, NaN, slow loader — with a live
+    serve prober, finishing byte-identical to the uninterrupted control.
+    The committed evidence run is docs/resilience/soak.json."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "chaos_soak",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "chaos_soak.py"),
+    )
+    soak = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(soak)
+    out = str(tmp_path / "soak.json")
+    rc = soak.main([
+        "--quick", "--workdir", str(tmp_path / "work"), "--out", out,
+    ])
+    report = json.load(open(out))
+    assert rc == 0, report
+    assert report["survived"] is True
+    assert report["trajectory_match"]["final_blob_byte_identical"]
+    assert report["serve"]["errors_5xx"] == []
+    assert report["quarantined_blobs"]
