@@ -268,6 +268,22 @@ class TrainConfig:
     # without an explicit ?steps=N); the capture ends with a device sync
     # and aggregates into <workdir>/top_ops_NNN.json (obs/profiling.py).
     profile_steps: int = 20
+    # Performance accounting (obs/flops.py, obs/comm.py, obs/hbm.py;
+    # docs/PERF.md "Accounting").  On, the trainer computes the per-step
+    # conv FLOP model once at start (a jaxpr trace, no compute) and
+    # publishes live ddlpc_mfu / ddlpc_goodput / ddlpc_hbm_bytes /
+    # ddlpc_comm_bytes_total on the telemetry endpoint, plus per-epoch
+    # kind="perf"/"comm" JSONL records (scripts/perf_report.py renders
+    # them).  Traced runs additionally sample a fenced comm-time probe
+    # once per epoch on the trace_sync cadence.  Steady-state cost is a
+    # few counter updates per optimizer step (measured inside PR 6's
+    # <=2% traced-step bar).
+    perf_accounting: bool = True
+    # Peak FLOP/s per device for the MFU denominator; 0 = auto (device
+    # kind lookup, falling back to the v5e peak with
+    # ddlpc_peak_flops_assumed=1 so numbers stay comparable with the
+    # committed bench tables).
+    peak_flops_per_device: float = 0.0
 
 
 @dataclass(frozen=True)

@@ -12,7 +12,12 @@ One subsystem, four capabilities, shared by training and serving:
   the xplane self-time logic into a committed-format top-ops report;
 - :mod:`health` — EWMA step-time regression, loss NaN/spike and serve
   queue-saturation detectors emitting structured alert records into the
-  metrics stream and the ``StallWatchdog``'s diagnosis.
+  metrics stream and the ``StallWatchdog``'s diagnosis;
+- :mod:`flops` / :mod:`comm` / :mod:`hbm` — performance accounting
+  (docs/PERF.md "Accounting"): the per-step conv FLOP model behind the
+  live ``ddlpc_mfu``/``ddlpc_goodput`` gauges, exact per-collective wire
+  byte counters + the fenced comm-time probe, and per-device HBM gauges
+  from shape × committed sharding.
 
 Everything except :mod:`profiling`/:mod:`xplane` is pure stdlib — no jax
 import at module scope — so the tracer and registry are importable (and
@@ -25,7 +30,12 @@ testable) anywhere, including the serve path's worker threads.
 
 from __future__ import annotations
 
-from ddlpc_tpu.obs.schema import SCHEMA_VERSION, check_record  # noqa: E402
+from ddlpc_tpu.obs.schema import (  # noqa: E402
+    KNOWN_KINDS,
+    SCHEMA_VERSION,
+    check_record,
+    is_stale,
+)
 
 from ddlpc_tpu.obs.health import (  # noqa: E402
     Alert,
@@ -43,6 +53,7 @@ from ddlpc_tpu.obs.registry import (  # noqa: E402
 from ddlpc_tpu.obs.tracing import NULL_SPAN, Span, Tracer  # noqa: E402
 
 __all__ = [
+    "KNOWN_KINDS",
     "SCHEMA_VERSION",
     "Alert",
     "Counter",
@@ -57,4 +68,5 @@ __all__ = [
     "Span",
     "Tracer",
     "check_record",
+    "is_stale",
 ]

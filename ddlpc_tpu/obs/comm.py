@@ -1,0 +1,340 @@
+"""Communication accounting: exact logical wire bytes + a fenced comm probe.
+
+The ROADMAP's biggest open perf item — fused quantized collectives with
+comm/compute overlap — cannot be judged without first knowing (a) how many
+bytes each codec actually puts on the wire per step and (b) what fraction
+of the step is communication.  This module owns both:
+
+- **Byte accounting** (:func:`comm_plan`, :class:`CommAccountant`) —
+  closed-form per-step logical payload bytes for every collective the
+  step variants issue (``parallel/grad_sync.py``'s ``sync_gradients`` /
+  ``sync_gradients_scatter``, ``parallel/compressed_allreduce.py``'s
+  ring), pre- and post-codec, published as
+  ``ddlpc_comm_bytes_total{collective,codec,stage}`` counters and a
+  ``ddlpc_comm_compression_ratio`` gauge.  "Logical" means the tensor
+  bytes a replica contributes to the collective — what a compressed wire
+  format carries; the simulate transport physically moves fp32 regardless
+  (the codec is an information-loss model there), the ring transport's
+  numbers are its REAL per-hop wire bytes (``ring_wire_report``).
+  Exactness is the contract: int8 → ``n·1 + 4`` (one global fp32 scale),
+  float16 → ``n·2 + 4``, none → ``n·4`` (test-pinned against closed
+  form).  A singleton data axis has no communication and counts zero.
+
+- **Fenced comm-time probe** (:func:`make_comm_probe`) — a compiled
+  program running ONLY the gradient sync (the training step's exact
+  ``sync_gradients``/``sync_gradients_scatter`` call, codec fences and
+  all) on a parameter-shaped dummy tree.  The trainer samples it on the
+  existing ``trace_sync_every_steps`` cadence; the measured seconds yield
+  ``ddlpc_comm_fraction`` (comm seconds / step seconds) and
+  ``ddlpc_comm_overlap_headroom_s`` — the step-time saving a perfect
+  backward/sync overlap could claim, ``min(t_comm, t_step − t_comm)`` —
+  which is the committed baseline the future overlap PR is judged
+  against (docs/PERF.md "Accounting").
+
+jax stays a lazy import (probe construction only); the byte math is pure.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+# Wire itemsize per codec mode for the simulate transport's logical
+# payload (the ring transport computes its own hop dtype — see
+# compressed_allreduce.wire_dtype).
+CODEC_ITEMSIZE = {"none": 4, "int8": 1, "float16": 2}
+# One global (whole-model) fp32 absmax scale per quantized payload
+# (ops/quantize.py:Encoded).
+SCALE_BYTES = 4
+
+
+def tree_elements(tree) -> int:
+    """Total element count of a pytree of arrays/ShapeDtypeStructs."""
+    import jax
+    import numpy as np
+
+    return int(
+        sum(int(np.prod(l.shape)) for l in jax.tree.leaves(tree))
+    )
+
+
+def codec_payload_bytes(n_elements: int, mode: str) -> int:
+    """Logical payload bytes for ``n_elements`` after the codec: the wire
+    dtype's bytes plus the global scale scalar (quantizing modes only)."""
+    if mode not in CODEC_ITEMSIZE:
+        raise ValueError(f"unknown compression mode {mode!r}")
+    nbytes = n_elements * CODEC_ITEMSIZE[mode]
+    if mode != "none":
+        nbytes += SCALE_BYTES
+    return nbytes
+
+
+def comm_plan(
+    n_grad_elements: int,
+    n_param_elements: int,
+    compression,
+    axis_size: int,
+    variant: str,
+) -> List[Dict[str, object]]:
+    """Per-optimizer-step collective rows for one step variant.
+
+    ``variant`` ∈ ``allreduce`` (replicated shard_map step),
+    ``scatter`` (ZeRO-1: reduce-scatter grads + all-gather params),
+    ``ring`` (compressed ppermute transport), ``gspmd`` (partitioner-
+    inserted all-reduce; no per-replica quantize stage exists there, so
+    the wire payload is fp32 — train_step.py documents why).
+
+    Each row: ``collective``, ``codec`` (the mode the wire payload is in),
+    ``bytes_pre`` (fp32 bytes entering the codec) and ``bytes_post``
+    (bytes leaving it), per replica per step.  Singleton meshes
+    communicate nothing → empty plan.
+    """
+    if axis_size <= 1:
+        return []
+    mode = compression.mode
+    fp32 = n_grad_elements * 4
+    if variant == "allreduce":
+        # quantize_local is the codec stage ahead of the wire; without it
+        # (or with mode none) the payload stays fp32.
+        wire_mode = mode if (mode != "none" and compression.quantize_local) else "none"
+        return [
+            {
+                "collective": "all_reduce",
+                "codec": wire_mode,
+                "bytes_pre": fp32,
+                "bytes_post": codec_payload_bytes(n_grad_elements, wire_mode),
+            }
+        ]
+    if variant == "scatter":
+        wire_mode = mode if (mode != "none" and compression.quantize_local) else "none"
+        return [
+            {
+                "collective": "reduce_scatter",
+                "codec": wire_mode,
+                "bytes_pre": fp32,
+                "bytes_post": codec_payload_bytes(n_grad_elements, wire_mode),
+            },
+            # The fresh-params publish of the ZeRO-1 update: uncompressed
+            # by construction (params, not grads).
+            {
+                "collective": "all_gather",
+                "codec": "none",
+                "bytes_pre": n_param_elements * 4,
+                "bytes_post": n_param_elements * 4,
+            },
+        ]
+    if variant == "ring":
+        if mode == "none":
+            # The ring falls back to an exact pmean for mode='none'.
+            return [
+                {
+                    "collective": "ring_all_reduce",
+                    "codec": "none",
+                    "bytes_pre": fp32,
+                    "bytes_post": fp32,
+                }
+            ]
+        from ddlpc_tpu.parallel.compressed_allreduce import ring_wire_report
+
+        rep = ring_wire_report(n_grad_elements, axis_size, compression)
+        return [
+            {
+                "collective": "ring_all_reduce",
+                "codec": mode,
+                # The ring's REAL per-replica hop bytes, fp32 ring vs
+                # quantized ring — exact by construction (dtype × chunk ×
+                # hops), not the logical-payload convention above.
+                "bytes_pre": rep["fp32_bytes_per_replica"],
+                "bytes_post": rep["wire_bytes_per_replica"],
+            }
+        ]
+    if variant == "gspmd":
+        return [
+            {
+                "collective": "all_reduce",
+                "codec": "none",
+                "bytes_pre": fp32,
+                "bytes_post": fp32,
+            }
+        ]
+    raise ValueError(f"unknown comm plan variant {variant!r}")
+
+
+class CommAccountant:
+    """Registry-backed per-step communication accounting.
+
+    ``on_step`` (called once per optimizer step from the trainer loop —
+    a handful of counter increments) accumulates the plan's byte rows
+    into ``ddlpc_comm_bytes_total``; ``record_probe`` stores a sampled
+    fenced comm-time measurement; ``publish`` refreshes the derived
+    gauges and returns the flat ``kind="comm"`` JSONL record.
+    """
+
+    def __init__(self, registry, plan: List[Dict[str, object]], variant: str):
+        self.plan = list(plan)
+        self.variant = variant
+        self._lock = threading.Lock()
+        self._steps = 0
+        self._probe_s: Optional[float] = None
+        self._bytes = registry.counter(
+            "ddlpc_comm_bytes_total",
+            "Logical collective payload bytes per replica (pre_codec = "
+            "fp32 entering the codec, post_codec = wire payload leaving "
+            "it; ring rows are real per-hop wire bytes).",
+            labelnames=("collective", "codec", "stage"),
+        )
+        self._ratio = registry.gauge(
+            "ddlpc_comm_compression_ratio",
+            "Measured pre/post codec byte ratio per collective.",
+            labelnames=("collective",),
+        )
+        self._g_comm_s = registry.gauge(
+            "ddlpc_comm_seconds_per_step",
+            "Sampled fenced gradient-sync seconds (comm-only program).",
+        )
+        self._g_frac = registry.gauge(
+            "ddlpc_comm_fraction",
+            "Sampled comm seconds over mean optimizer-step seconds.",
+        )
+        self._g_headroom = registry.gauge(
+            "ddlpc_comm_overlap_headroom_s",
+            "Per-step seconds a perfect comm/compute overlap could save: "
+            "min(t_comm, t_step - t_comm).",
+        )
+        for row in self.plan:
+            self._ratio.set(
+                row["bytes_pre"] / max(row["bytes_post"], 1),
+                collective=row["collective"],
+            )
+
+    def on_step(self, n: int = 1) -> None:
+        for row in self.plan:
+            self._bytes.inc(
+                row["bytes_pre"] * n,
+                collective=row["collective"],
+                codec=row["codec"],
+                stage="pre_codec",
+            )
+            self._bytes.inc(
+                row["bytes_post"] * n,
+                collective=row["collective"],
+                codec=row["codec"],
+                stage="post_codec",
+            )
+        with self._lock:
+            self._steps += n
+
+    def record_probe(self, comm_seconds: float) -> None:
+        with self._lock:
+            self._probe_s = float(comm_seconds)
+        self._g_comm_s.set(float(comm_seconds))
+
+    def publish(self, step_time_s: Optional[float] = None) -> Dict[str, object]:
+        with self._lock:
+            steps = self._steps
+            probe_s = self._probe_s
+        rec: Dict[str, object] = {"kind": "comm", "variant": self.variant,
+                                  "steps": steps}
+        for row in self.plan:
+            name = str(row["collective"])
+            rec[f"{name}_bytes_pre_per_step"] = row["bytes_pre"]
+            rec[f"{name}_bytes_post_per_step"] = row["bytes_post"]
+            rec[f"{name}_codec"] = row["codec"]
+            rec[f"{name}_compression_ratio"] = round(
+                row["bytes_pre"] / max(row["bytes_post"], 1), 4
+            )
+        if probe_s is not None:
+            rec["comm_s_per_step"] = round(probe_s, 6)
+            if step_time_s and step_time_s > 0:
+                frac = min(probe_s / step_time_s, 1.0)
+                headroom = max(min(probe_s, step_time_s - probe_s), 0.0)
+                self._g_frac.set(frac)
+                self._g_headroom.set(headroom)
+                rec["comm_fraction"] = round(frac, 4)
+                rec["overlap_headroom_s"] = round(headroom, 6)
+                rec["step_time_s"] = round(float(step_time_s), 6)
+        return rec
+
+
+def make_comm_probe(
+    mesh,
+    compression,
+    params,
+    data_axis: str = "data",
+    scatter: bool = False,
+    seed: int = 0,
+):
+    """A callable measuring the fenced gradient-sync seconds in isolation.
+
+    Compiles the training step's EXACT sync call (``sync_gradients`` or,
+    under the ZeRO-1 layout, ``sync_gradients_scatter`` — codec fences
+    included) over a parameter-shaped dummy gradient tree, replicated the
+    way the step sees it.  The first call warms up (compile + one run);
+    every call returns the wall seconds of one synchronized execution.
+    Runs nothing at construction time.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ddlpc_tpu.parallel.grad_sync import (
+        sync_gradients,
+        sync_gradients_scatter,
+    )
+    from ddlpc_tpu.utils.compat import shard_map
+
+    axis_size = mesh.shape[data_axis]
+    use_scatter = bool(scatter) and axis_size > 1
+    stochastic = (
+        compression.mode != "none" and compression.rounding == "stochastic"
+    )
+
+    def body(grads):
+        # Static-seed key built inside the program, the _rounding_rng
+        # pattern (train_step.py): every probe run rounds with the same
+        # noise — right for timing the codec's real threefry cost.
+        key = jax.random.key(seed) if stochastic else None
+        if use_scatter:
+            return sync_gradients_scatter(
+                grads, data_axis, compression, axis_size=axis_size, key=key
+            )
+        return sync_gradients(
+            grads, data_axis, compression, axis_size=axis_size, key=key
+        )
+
+    out_spec = P(data_axis) if use_scatter else P()
+    fn = jax.jit(
+        shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(),),
+            out_specs=out_spec,
+            check=False,
+        )
+    )
+
+    state = {"warmed": False}
+
+    def probe() -> float:
+        # The dummy gradient tree is rebuilt per probe and dropped right
+        # after: holding it between once-per-epoch samples would pin a
+        # full grads-sized fp32 buffer per device for the whole run —
+        # exactly the HBM the accounting exists to watch.  The jit cache
+        # keeps the compile across probes (shapes are stable).
+        rng = np.random.default_rng(0)
+        grads = jax.tree.map(
+            lambda p: jax.device_put(
+                rng.standard_normal(p.shape).astype(np.float32) * 1e-3,
+                NamedSharding(mesh, P()),
+            ),
+            params,
+        )
+        if not state["warmed"]:
+            jax.block_until_ready(fn(grads))  # compile + warm
+            state["warmed"] = True
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(grads))
+        return time.perf_counter() - t0
+
+    return probe

@@ -1,0 +1,78 @@
+"""Live per-device HBM accounting from shape × committed sharding.
+
+The accounting ``scripts/hbm_report.py`` used to carry privately, hoisted
+into the package (the ``obs/xplane.py`` precedent: one implementation for
+the CLI and the live hooks): every leaf's per-device bytes come exactly
+from ``sharding.shard_shape(global_shape) × itemsize`` — decided at
+partitioning time, identically on every backend — so the numbers are
+backend-independent and free to compute.
+
+:func:`publish_hbm_gauges` turns a placed ``TrainState`` into
+``ddlpc_hbm_bytes{kind=params|grads|opt_state|batch_stats}`` per-device
+gauges on the training ``/metrics`` endpoint.  ``grads`` is the
+accumulated fp32 gradient tree, which both step variants materialize at
+full per-replica size between the backward pass and the sync (the ZeRO-1
+path scatters AFTER accumulation — docs/SHARDING.md), so it is counted at
+``Σ param_elements × 4`` regardless of the update layout.
+
+jax is only needed for the tree walk; imported lazily like the rest of
+``obs/``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+
+def leaf_bytes_per_device(tree) -> int:
+    """Per-device resident bytes of a pytree of placed jax Arrays (or
+    ShapeDtypeStructs with shardings): Σ prod(shard_shape) × itemsize."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shard_shape = leaf.sharding.shard_shape(leaf.shape)
+        n = 1
+        for d in shard_shape:
+            n *= d
+        total += n * leaf.dtype.itemsize
+    return total
+
+
+def grads_bytes_per_device(params) -> int:
+    """Bytes of the accumulated fp32 gradient tree one device holds
+    between backward and sync: full parameter element count × 4 (both the
+    replicated and the ZeRO-1 paths accumulate full per-replica grads)."""
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        total += int(np.prod(leaf.shape)) * 4
+    return total
+
+
+def state_hbm_bytes(state) -> Dict[str, int]:
+    """Per-device byte breakdown of a placed TrainState, by kind."""
+    return {
+        "params": leaf_bytes_per_device(state.params),
+        "grads": grads_bytes_per_device(state.params),
+        "opt_state": leaf_bytes_per_device(state.opt_state),
+        "batch_stats": leaf_bytes_per_device(state.batch_stats),
+    }
+
+
+def publish_hbm_gauges(registry, state) -> Dict[str, int]:
+    """Set ``ddlpc_hbm_bytes{kind}`` gauges from a placed TrainState;
+    returns the breakdown.  Static per run layout — the trainer publishes
+    once after state placement."""
+    gauge = registry.gauge(
+        "ddlpc_hbm_bytes",
+        "Per-device resident state bytes from shape x committed sharding "
+        "(grads = accumulated fp32 gradient tree, full per replica).",
+        labelnames=("kind",),
+    )
+    breakdown = state_hbm_bytes(state)
+    for kind, nbytes in breakdown.items():
+        gauge.set(float(nbytes), kind=kind)
+    return breakdown

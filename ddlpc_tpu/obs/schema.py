@@ -17,6 +17,26 @@ from typing import List
 # is not a bump.
 SCHEMA_VERSION = 1
 
+# Every record ``kind`` the repo's emitters stamp (records without a
+# ``kind`` are training metrics, kind "train").  The lint rejects unknown
+# kinds so a typo'd emitter cannot silently fork a new stream dialect;
+# new subsystems register their kinds here first.
+KNOWN_KINDS = frozenset(
+    {
+        "train",  # per-epoch training metrics (the kind-less default)
+        "span",  # tracer (obs/tracing.py)
+        "alert",  # health detectors (obs/health.py)
+        "serve",  # serve metrics snapshots (serve/metrics.py)
+        "serve_reload",  # hot-reload audit records (serve/server.py)
+        "profile",  # on-demand profiler reports (obs/profiling.py)
+        "preempt",  # graceful-preemption record (train/trainer.py)
+        "supervisor_attempt",  # resilience.jsonl (resilience/supervisor.py)
+        "supervisor_give_up",
+        "perf",  # goodput/MFU accounting (obs/flops.py, per epoch)
+        "comm",  # communication accounting (obs/comm.py)
+    }
+)
+
 _SCALAR = (str, int, float, bool, type(None))
 
 
@@ -25,8 +45,12 @@ def check_record(obj: object) -> List[str]:
 
     A conforming record is a JSON object whose values are scalars or lists
     of scalars (``val_iou_per_class`` is a list), carrying an integer
-    ``schema`` field.  Returns human-readable violation strings; empty
-    means conforming.
+    ``schema`` field at or below :data:`SCHEMA_VERSION` and (when present)
+    a ``kind`` from :data:`KNOWN_KINDS`.  Records from OLDER schema
+    versions are tolerated (long-lived runs survive an in-place tooling
+    upgrade — :func:`is_stale` lets tools count and report them); records
+    claiming a NEWER version than this tooling understands are violations.
+    Returns human-readable violation strings; empty means conforming.
     """
     errs: List[str] = []
     if not isinstance(obj, dict):
@@ -36,6 +60,23 @@ def check_record(obj: object) -> List[str]:
         errs.append("missing 'schema' field")
     elif not isinstance(schema, int) or isinstance(schema, bool):
         errs.append(f"'schema' must be an integer, got {schema!r}")
+    elif schema > SCHEMA_VERSION:
+        errs.append(
+            f"'schema' {schema} is newer than this tooling's "
+            f"SCHEMA_VERSION {SCHEMA_VERSION} — upgrade the tooling"
+        )
+    elif schema < 0:
+        # Versions start at 1 (0 grandfathers pre-stamp records); a
+        # negative stamp is an emitter bug, not an old version.
+        errs.append(f"'schema' {schema} is not a valid version")
+    kind = obj.get("kind")
+    if kind is not None and (
+        not isinstance(kind, str) or kind not in KNOWN_KINDS
+    ):
+        errs.append(
+            f"unknown record kind {kind!r} — register it in "
+            f"obs/schema.py:KNOWN_KINDS"
+        )
     for k, v in obj.items():
         if isinstance(v, _SCALAR):
             continue
@@ -46,3 +87,17 @@ def check_record(obj: object) -> List[str]:
             f"(scalars or lists of scalars)"
         )
     return errs
+
+
+def is_stale(obj: object) -> bool:
+    """True for a record stamped with an OLDER (still valid) schema
+    version: conforming, but worth reporting — the stream predates the
+    current tooling (e.g. a long-lived run tailed across an upgrade)."""
+    if not isinstance(obj, dict):
+        return False
+    schema = obj.get("schema")
+    return (
+        isinstance(schema, int)
+        and not isinstance(schema, bool)
+        and 0 <= schema < SCHEMA_VERSION
+    )

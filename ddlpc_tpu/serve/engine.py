@@ -200,6 +200,29 @@ class InferenceEngine:
         # own jax.jit wrapper; len(cache) is the number of live executables.
         self._jit_cache: Dict[Tuple[int, int, int, int], Callable] = {}
         self.forward_calls = 0
+        # Optional jit-cache hit/miss counters (attach_registry): the
+        # continuous-batching work needs to SEE whether ragged traffic is
+        # reusing executables or compiling its way through the bucket set.
+        self._cache_hits = None
+        self._cache_misses = None
+
+    def attach_registry(self, registry) -> None:
+        """Publish ``ddlpc_serve_jit_cache_{hits,misses}_total{bucket}``
+        counters into a MetricsRegistry (obs/registry.py) — wired by the
+        ServingFrontend so the shape-bucketed cache's behavior is visible
+        on the existing content-negotiated ``/metrics``."""
+        self._cache_hits = registry.counter(
+            "ddlpc_serve_jit_cache_hits_total",
+            "forward_windows calls served by an existing executable, by "
+            "batch bucket.",
+            labelnames=("bucket",),
+        )
+        self._cache_misses = registry.counter(
+            "ddlpc_serve_jit_cache_misses_total",
+            "forward_windows calls that created a new jit wrapper "
+            "(compile on first execution), by batch bucket.",
+            labelnames=("bucket",),
+        )
 
     # ---- construction ------------------------------------------------------
 
@@ -311,11 +334,15 @@ class InferenceEngine:
     def _logits_fn(self, key: Tuple[int, int, int, int]) -> Callable:
         with self._lock:
             fn = self._jit_cache.get(key)
+            hit = fn is not None
             if fn is None:
                 from ddlpc_tpu.parallel.train_step import make_logits_fn
 
                 fn = self._jit_cache[key] = make_logits_fn(self.model)
-            return fn
+        counter = self._cache_hits if hit else self._cache_misses
+        if counter is not None:
+            counter.inc(bucket=str(key[0]))
+        return fn
 
     @property
     def compiled_shapes(self) -> int:

@@ -80,6 +80,11 @@ class ServingFrontend:
         self.metrics = ServeMetrics(
             window=self.cfg.metrics_window, registry=self.registry
         )
+        # Shape-bucketed jit cache visibility (getattr: tests drive the
+        # frontend with minimal fake engines).
+        attach = getattr(engine, "attach_registry", None)
+        if attach is not None:
+            attach(self.registry)
         self.batcher = MicroBatcher(
             engine.forward_windows,
             max_batch=self.cfg.max_batch,

@@ -42,6 +42,9 @@ from ddlpc_tpu.parallel.train_step import (
     make_train_step,
     make_train_step_gspmd,
 )
+from ddlpc_tpu.obs import comm as obs_comm
+from ddlpc_tpu.obs import flops as obs_flops
+from ddlpc_tpu.obs import hbm as obs_hbm
 from ddlpc_tpu.obs.health import HealthMonitor
 from ddlpc_tpu.obs.http import TelemetryServer
 from ddlpc_tpu.obs.profiling import OnDemandProfiler
@@ -254,6 +257,82 @@ class Trainer:
                 data_axis=cfg.parallel.data_axis_name,
             )
         self.predict = make_predict_fn(self.model)
+
+        # Performance accounting (docs/PERF.md "Accounting"): a per-step
+        # conv FLOP model traced once (no compute), live MFU/goodput and
+        # per-device HBM gauges, and exact per-collective comm byte
+        # counters for the configured codec/transport.  The comm-time
+        # probe (a compiled sync-only program) is built lazily and sampled
+        # at most once per epoch on the trace_sync cadence.
+        self.perf: Optional[obs_flops.PerfAccountant] = None
+        self.comm: Optional[obs_comm.CommAccountant] = None
+        self._comm_probe = None
+        self._comm_probed_epoch = False
+        if cfg.train.perf_accounting:
+            try:
+                flops_per_step = obs_flops.conv_step_flops(
+                    cfg, cfg.train.micro_batch_size, cfg.train.sync_period,
+                    channels=channels,
+                )
+                if self.spatial:
+                    # The trace is the UNPARTITIONED per-micro-batch
+                    # program; under H-sharding each device executes
+                    # ~1/space of those convs (halo recompute ignored —
+                    # a few rows per conv).  Without this, spatial MFU
+                    # overstates by space_axis_size.
+                    flops_per_step //= cfg.parallel.space_axis_size
+            except Exception as e:  # accounting must never kill the run
+                warnings.warn(
+                    f"per-step FLOP model unavailable ({type(e).__name__}: "
+                    f"{e}); ddlpc_mfu will read 0",
+                    stacklevel=2,
+                )
+                flops_per_step = 0
+            peak, assumed = obs_flops.resolve_peak_flops(
+                cfg.train.peak_flops_per_device
+            )
+            self.perf = obs_flops.PerfAccountant(
+                self.registry,
+                flops_per_step=flops_per_step,
+                peak_flops=peak,
+                peak_assumed=assumed,
+                # Downtime inherited from a previous supervised attempt
+                # (breadcrumb / resilience.jsonl) — read BEFORE this run's
+                # first breadcrumb write, debited as category 'restart'.
+                restart_gap_s=obs_flops.restart_gap_seconds(cfg.workdir),
+            )
+            obs_hbm.publish_hbm_gauges(self.registry, self.state)
+            if cfg.compression.transport == "ring" and cfg.compression.mode != "none":
+                variant = "ring"
+            elif self.spatial:
+                variant = "gspmd"
+            elif self.shard_update:
+                variant = "scatter"
+            else:
+                variant = "allreduce"
+            n_params = obs_comm.tree_elements(self.state.params)
+            self.comm = obs_comm.CommAccountant(
+                self.registry,
+                obs_comm.comm_plan(
+                    n_params, n_params, cfg.compression, data_size, variant
+                ),
+                variant,
+            )
+            if not self.spatial and data_size > 1:
+                # Shape-only closure: the probe must not pin the initial
+                # (donated) param buffers alive.
+                param_shapes = jax.tree.map(
+                    lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype),
+                    self.state.params,
+                )
+                self._comm_probe = obs_comm.make_comm_probe(
+                    self.mesh,
+                    cfg.compression,
+                    param_shapes,
+                    data_axis=cfg.parallel.data_axis_name,
+                    scatter=self.shard_update,
+                    seed=cfg.train.seed,
+                )
 
         self.workdir = cfg.workdir
         self.ckpt_dir = os.path.join(self.workdir, "checkpoints")
@@ -566,6 +645,7 @@ class Trainer:
 
     def train_epoch(self, epoch: int) -> Dict[str, float]:
         self.loader.set_epoch(epoch)
+        self._comm_probed_epoch = False
         losses, accs = [], []
         t_epoch = time.perf_counter()
         it = iter(self.loader)
@@ -604,6 +684,10 @@ class Trainer:
             losses.append(metrics["loss"])
             accs.append(metrics["pixel_acc"])
             step_idx += 1
+            if self.comm is not None:
+                # Exact logical collective bytes for this optimizer step
+                # (obs/comm.py) — a handful of counter increments.
+                self.comm.on_step()
             if self._chaos is not None:
                 self._chaos_step += 1
                 # kill/stall act inside on_step; preempt comes back as an
@@ -622,6 +706,28 @@ class Trainer:
             if self.tracer.enabled and sync_every and step_idx % sync_every == 0:
                 with self.tracer.span("step_sync", epoch=epoch, step=step_idx):
                     jax.block_until_ready(metrics["loss"])
+                # Sampled fenced comm-time measurement, piggybacking on
+                # the sync cadence (the pipeline is already drained here,
+                # so the probe doesn't serialize dispatch): at most once
+                # per epoch, feeding ddlpc_comm_fraction / the overlap-
+                # headroom baseline (obs/comm.py).
+                if self._comm_probe is not None and not self._comm_probed_epoch:
+                    self._comm_probed_epoch = True
+                    t_probe = time.perf_counter()
+                    try:
+                        with self.tracer.span("comm_probe", epoch=epoch):
+                            self.comm.record_probe(self._comm_probe())
+                    except Exception as e:  # accounting never kills the run
+                        warnings.warn(
+                            f"comm probe failed ({type(e).__name__}: {e}); "
+                            f"disabling for this run",
+                            stacklevel=2,
+                        )
+                        self._comm_probe = None
+                    if self.perf is not None:
+                        self.perf.debit(
+                            "probe", time.perf_counter() - t_probe
+                        )
             # Drive the on-demand profiler (no-op unless armed); the sync
             # closure drains this step's dispatch queue INTO the capture.
             self.profiler.step_done(
@@ -675,6 +781,15 @@ class Trainer:
         record.update(
             {f"t_{name}_s": t for name, t in self.timer.means().items()}
         )
+        if self.perf is not None:
+            # Goodput accounting from the epoch's disjoint training-thread
+            # intervals: the compiled step dispatch is productive, the
+            # host wait for the next super-batch is a 'data' debit
+            # (loader_gather/cast/upload run on producer threads and
+            # overlap the step — they are throughput, not wall debits).
+            totals = self.timer.summary()
+            self.perf.productive(totals.get("step", 0.0), steps)
+            self.perf.debit("data", totals.get("data", 0.0))
         self.timer.reset()
         return record
 
@@ -829,6 +944,8 @@ class Trainer:
                 self.workdir, "running", start_epoch=self.start_epoch,
                 epochs=epochs,
             )
+        if self.perf is not None:
+            self.perf.start()
         try:
             with self.watchdog:
                 try:
@@ -846,8 +963,13 @@ class Trainer:
                         if cfg.eval_every_epochs and (epoch + 1) % cfg.eval_every_epochs == 0:
                             # evaluate() beats per batch; per-batch eval cost is
                             # step-like, so the step-sized timeout applies.
+                            t_eval = time.perf_counter()
                             with self.tracer.span("evaluate", epoch=epoch):
                                 record.update(self.evaluate())
+                            if self.perf is not None:
+                                self.perf.debit(
+                                    "eval", time.perf_counter() - t_eval
+                                )
                         if self._chaos is not None:
                             # nan@N fault: poison what the health detectors
                             # see (the stream logs the same poisoned value).
@@ -864,8 +986,32 @@ class Trainer:
                             # only for the host snapshot (plus a barrier if the
                             # PREVIOUS write is somehow still running); the write
                             # itself overlaps the next epoch.
+                            t_ckpt = time.perf_counter()
                             with self.watchdog.paused("checkpoint"):
                                 self.save(epoch)
+                            if self.perf is not None:
+                                # The training-thread STALL (snapshot +
+                                # barrier), not the background write.
+                                self.perf.debit(
+                                    "checkpoint", time.perf_counter() - t_ckpt
+                                )
+                        if self.perf is not None:
+                            # Refresh ddlpc_mfu/ddlpc_goodput and append the
+                            # flat kind="perf"/"comm" accounting records
+                            # (scripts/perf_report.py renders these).
+                            self.logger.log(
+                                self.perf.publish(
+                                    step_time_s=record.get("step_time_s")
+                                ),
+                                echo=False,
+                            )
+                            if self.comm is not None:
+                                self.logger.log(
+                                    self.comm.publish(
+                                        step_time_s=record.get("step_time_s")
+                                    ),
+                                    echo=False,
+                                )
                         if cfg.dump_images_per_epoch:
                             with self.watchdog.paused("image_dump"):
                                 self.dump_images(epoch)

@@ -1,9 +1,11 @@
 """Lint the flat-JSONL telemetry stream contract (ddlpc_tpu/obs/schema.py).
 
-Every JSONL stream a run emits — metrics.jsonl, serve_metrics.jsonl,
-spans.jsonl, serve_spans.jsonl, resilience.jsonl (the supervisor's
-attempt/give-up stream) — must be one FLAT JSON object per line
-(scalars or lists of scalars) carrying an integer ``schema`` field.  That
+Every JSONL stream a run emits — metrics.jsonl (training records plus the
+interleaved alert and kind="perf"/"comm" accounting records),
+serve_metrics.jsonl, spans.jsonl, serve_spans.jsonl, resilience.jsonl
+(the supervisor's attempt/give-up stream) — must be one FLAT JSON object
+per line (scalars or lists of scalars) carrying an integer ``schema``
+field and a ``kind`` registered in obs/schema.py:KNOWN_KINDS.  That
 contract is what lets scripts/obs_tail.py tail any stream unchanged and
 lets downstream tooling parse without per-stream special cases; this lint
 (invoked from tier-1: tests/test_obs.py) keeps emitters honest.
@@ -28,11 +30,20 @@ from typing import List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from ddlpc_tpu.obs.schema import check_record  # noqa: E402
+from ddlpc_tpu.obs.schema import SCHEMA_VERSION, check_record, is_stale  # noqa: E402
 
 
-def lint_file(path: str, max_violations: int = 20) -> List[str]:
-    """``path:line: message`` strings for every contract violation."""
+def lint_file(
+    path: str, max_violations: int = 20, stale_out: Optional[List[int]] = None
+) -> List[str]:
+    """``path:line: message`` strings for every contract violation.
+
+    Records stamped with an OLDER (still valid) schema version are
+    tolerated — a long-lived run must survive an in-place tooling upgrade
+    — but counted into ``stale_out[0]`` so the summary can report them;
+    only a version NEWER than this tooling's is a violation
+    (obs/schema.py:check_record).
+    """
     out: List[str] = []
     with open(path, "r") as f:
         for lineno, line in enumerate(f, 1):
@@ -47,6 +58,8 @@ def lint_file(path: str, max_violations: int = 20) -> List[str]:
             except json.JSONDecodeError as e:
                 out.append(f"{path}:{lineno}: not valid JSON ({e.msg})")
                 continue
+            if stale_out is not None and is_stale(obj):
+                stale_out[0] += 1
             for err in check_record(obj):
                 out.append(f"{path}:{lineno}: {err}")
     return out
@@ -74,14 +87,25 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     violations: List[str] = []
     checked = 0
+    stale = [0]
     for path in files:
         checked += 1
-        violations.extend(lint_file(path, max_violations=args.max_violations))
+        violations.extend(
+            lint_file(
+                path, max_violations=args.max_violations, stale_out=stale
+            )
+        )
     for v in violations:
         print(v)
+    stale_note = (
+        f", {stale[0]} record(s) from older schema versions tolerated "
+        f"(< v{SCHEMA_VERSION})"
+        if stale[0]
+        else ""
+    )
     print(
         f"check_metrics_schema: {checked} file(s), "
-        f"{len(violations)} violation(s)",
+        f"{len(violations)} violation(s){stale_note}",
         file=sys.stderr,
     )
     return 1 if violations else 0

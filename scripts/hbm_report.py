@@ -30,16 +30,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _leaf_bytes_per_device(tree) -> int:
-    import jax
+    # Shape×sharding accounting lives in the package now
+    # (ddlpc_tpu/obs/hbm.py) — one implementation for this CLI and the
+    # trainer's live ddlpc_hbm_bytes gauges.
+    from ddlpc_tpu.obs.hbm import leaf_bytes_per_device
 
-    total = 0
-    for leaf in jax.tree.leaves(tree):
-        shard_shape = leaf.sharding.shard_shape(leaf.shape)
-        n = 1
-        for d in shard_shape:
-            n *= d
-        total += n * leaf.dtype.itemsize
-    return total
+    return leaf_bytes_per_device(tree)
 
 
 def _memory_analysis(compiled) -> dict:

@@ -10,6 +10,7 @@ Usage:
     python scripts/obs_tail.py runs/flagship                  # whole run dir
     python scripts/obs_tail.py runs/x/spans.jsonl -f          # follow
     python scripts/obs_tail.py runs/x --kind span,alert       # by record kind
+    python scripts/obs_tail.py runs/x --kind perf,comm        # accounting
     python scripts/obs_tail.py runs/x --where name=jit_execute
     python scripts/obs_tail.py runs/x --keys loss,step_time_s # trim columns
     python scripts/obs_tail.py runs/x -n 50                   # last 50/file
@@ -35,6 +36,26 @@ import os
 import sys
 import time
 from typing import Dict, List, Optional, TextIO
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _note_stale(rec: dict, src: str, noted: set) -> None:
+    """Report (once per file, to stderr) records from OLDER schema
+    versions: a long-lived run tailed across an in-place upgrade keeps
+    streaming — tolerate-and-report, never fail the stream."""
+    try:
+        from ddlpc_tpu.obs.schema import SCHEMA_VERSION, is_stale
+    except ImportError:
+        return
+    if src not in noted and is_stale(rec):
+        noted.add(src)
+        print(
+            f"obs_tail: {src}: record(s) from older schema version "
+            f"{rec.get('schema')} (tooling is v{SCHEMA_VERSION}) — "
+            f"tolerated",
+            file=sys.stderr,
+        )
 
 
 def _match(rec: dict, kinds: Optional[set], where: Dict[str, str]) -> bool:
@@ -94,6 +115,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 1
 
     handles: Dict[str, TextIO] = {}
+    stale_noted: set = set()
     for path in files:
         try:
             fh = open(path, "r")
@@ -110,6 +132,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 rec = json.loads(line)
             except json.JSONDecodeError:
                 continue
+            _note_stale(rec, src, stale_noted)
             if _match(rec, kinds, where):
                 _emit(rec, src, keys, sys.stdout)
         handles[path] = fh
@@ -139,6 +162,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                         rec = json.loads(line)
                     except json.JSONDecodeError:
                         continue
+                    _note_stale(rec, os.path.basename(path), stale_noted)
                     if _match(rec, kinds, where):
                         _emit(rec, os.path.basename(path), keys, sys.stdout)
             if idle:

@@ -1,0 +1,493 @@
+"""Performance regression gate: replay the cheap bench arms vs a baseline.
+
+The repo's perf evidence used to die in one-shot committed JSON; this gate
+makes the cheap arms REPLAYABLE and COMPARABLE: it re-measures
+
+- ``update_step_ms``   — the weight-update-only compiled program
+  (``bench.measure_update_ms``: grad sync + codec + Adam + — sharded —
+  the params all-gather) on a tiny model;
+- ``train_step_ms``    — the full compiled train step (fwd/bwd ×
+  sync_period + sync + update) on the same tiny model;
+- ``comm_fraction``    — the fenced comm-only probe (obs/comm.py) over
+  ``train_step_ms``: the step attribution number the future
+  comm/compute-overlap work is judged against;
+- ``loader_tiles_per_s`` — the ShardedLoader host gather→cast→upload
+  path on a synthetic dataset;
+- ``serve_p99_ms``     — the closed-loop serving load
+  (scripts/serve_bench.py) against a tiny synthetic checkpoint;
+
+and fails loudly (exit 1, naming the metric) when any gated metric
+regresses past its tolerance band versus the committed
+``docs/perf/baseline.json``.  Improvements always pass (the check is
+one-sided).  Baselines are HOST-BOUND: re-baseline with
+``--update-baseline`` when the hardware changes (the env block records
+what the numbers were measured on).
+
+Modes:
+  python scripts/perf_gate.py                      # measure + compare
+  python scripts/perf_gate.py --update-baseline    # measure + rewrite baseline
+  python scripts/perf_gate.py --smoke              # no measurement: validate
+        the committed baseline's schema and self-check the comparison
+        logic (a synthetic regression must be caught) — tier-1 runs this,
+        so a broken gate or stale baseline schema fails the suite.
+  python scripts/perf_gate.py --inject update_step_ms=1.15
+        # multiply a measured value (regression-injection demonstration)
+
+Exit status: 0 pass, 1 regression/self-check failure (each printed as
+``perf_gate: REGRESSION <metric>: ...``), 2 usage/baseline error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "scripts"))
+
+BASELINE_SCHEMA = 1
+DEFAULT_BASELINE = os.path.join(_REPO, "docs", "perf", "baseline.json")
+
+# Gated metrics and their committed tolerance bands.  update_step_ms is
+# deliberately tight (the acceptance bar: a >=10% regression must fail);
+# loader/serve arms carry more CPU-host noise and get wider bands.  A
+# failing gate on an unchanged tree means host noise — rerun once; twice
+# means believe it.
+GATED = {
+    "update_step_ms": dict(unit="ms", direction="lower", tolerance=0.08),
+    "train_step_ms": dict(unit="ms", direction="lower", tolerance=0.25),
+    "comm_fraction": dict(unit="ratio", direction="lower", tolerance=0.50),
+    "loader_tiles_per_s": dict(
+        unit="tiles/s", direction="higher", tolerance=0.50
+    ),
+    "serve_p99_ms": dict(unit="ms", direction="lower", tolerance=0.60),
+}
+
+
+# --------------------------------------------------------------------------
+# comparison logic (pure — unit-tested and self-checked by --smoke)
+# --------------------------------------------------------------------------
+
+
+def validate_baseline(obj: object) -> List[str]:
+    """Schema errors for a decoded baseline document (empty = valid)."""
+    errs: List[str] = []
+    if not isinstance(obj, dict):
+        return ["baseline is not a JSON object"]
+    if obj.get("schema") != BASELINE_SCHEMA:
+        errs.append(
+            f"baseline schema {obj.get('schema')!r} != {BASELINE_SCHEMA}"
+        )
+    metrics = obj.get("metrics")
+    if not isinstance(metrics, dict) or not metrics:
+        return errs + ["baseline has no 'metrics' table"]
+    for name, spec in metrics.items():
+        if not isinstance(spec, dict):
+            errs.append(f"metric {name!r}: spec is not an object")
+            continue
+        v = spec.get("value")
+        if not isinstance(v, (int, float)) or isinstance(v, bool) or v <= 0:
+            errs.append(f"metric {name!r}: value must be a positive number")
+        tol = spec.get("tolerance")
+        if not isinstance(tol, (int, float)) or not 0 < tol < 1:
+            errs.append(f"metric {name!r}: tolerance must be in (0, 1)")
+        if spec.get("direction") not in ("lower", "higher"):
+            errs.append(f"metric {name!r}: direction must be lower|higher")
+    return errs
+
+
+def compare(
+    baseline_metrics: Dict[str, dict],
+    measured: Dict[str, float],
+    inject: Optional[Dict[str, float]] = None,
+) -> List[str]:
+    """``REGRESSION <metric>: ...`` strings for every gated metric in
+    ``measured`` that regressed past its band.  Metrics absent from
+    ``measured`` (a ``--skip-*`` arm) are not compared; improvements pass.
+    ``inject`` multiplies measured values first (the demonstration knob).
+    """
+    failures: List[str] = []
+    inject = inject or {}
+    for name, spec in sorted(baseline_metrics.items()):
+        if name not in measured:
+            continue
+        base = float(spec["value"])
+        tol = float(spec["tolerance"])
+        m = float(measured[name]) * float(inject.get(name, 1.0))
+        if spec["direction"] == "lower":
+            reg = (m - base) / base
+        else:
+            reg = (base - m) / base
+        if reg > tol:
+            failures.append(
+                f"REGRESSION {name}: measured {m:.4g} {spec.get('unit', '')} "
+                f"vs baseline {base:.4g} "
+                f"({'+' if reg >= 0 else ''}{reg * 100:.1f}% worse > "
+                f"tolerance {tol * 100:.0f}%)"
+            )
+    return failures
+
+
+def smoke(baseline_path: str) -> int:
+    """Validate the committed baseline + self-check the gate logic.
+
+    No measurement, no jax import — cheap enough for tier-1.  Fails (1)
+    if the baseline is missing/invalid or if a synthetic regression of
+    2× tolerance on any gated metric slips through the comparator.
+    """
+    try:
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"perf_gate --smoke: cannot load {baseline_path}: {e}")
+        return 1
+    errs = validate_baseline(baseline)
+    if errs:
+        for e in errs:
+            print(f"perf_gate --smoke: {e}")
+        return 1
+    metrics = baseline["metrics"]
+    clean = {n: float(s["value"]) for n, s in metrics.items()}
+    if compare(metrics, clean):
+        print("perf_gate --smoke: baseline fails against itself")
+        return 1
+    for name, spec in metrics.items():
+        # Inject a regression 1.5× past the band (capped below 100% for
+        # higher-is-better metrics, where regression saturates at 1).
+        reg = min(1.5 * float(spec["tolerance"]), 0.95)
+        if spec["direction"] == "higher":
+            factor = 1.0 - reg
+        else:
+            factor = 1.0 + reg
+        fails = compare(metrics, clean, inject={name: factor})
+        if not any(name in f for f in fails):
+            print(
+                f"perf_gate --smoke: injected {factor:.2f}x regression on "
+                f"{name!r} was NOT caught"
+            )
+            return 1
+    print(
+        f"perf_gate --smoke: baseline OK ({len(metrics)} gated metric(s), "
+        f"regression self-check passed)"
+    )
+    return 0
+
+
+# --------------------------------------------------------------------------
+# measurement arms (tiny, CPU-friendly — minutes, not hours)
+# --------------------------------------------------------------------------
+
+
+def _tiny_cfg():
+    from ddlpc_tpu.config import (
+        CompressionConfig,
+        DataConfig,
+        ExperimentConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+
+    return ExperimentConfig(
+        model=ModelConfig(
+            features=(8, 16), bottleneck_features=16, num_classes=6
+        ),
+        data=DataConfig(
+            dataset="synthetic", image_size=(32, 32), num_classes=6,
+            synthetic_len=64,
+        ),
+        train=TrainConfig(micro_batch_size=2, sync_period=2),
+        compression=CompressionConfig(mode="float16"),
+    )
+
+
+def arm_step_and_comm(rounds: int) -> Dict[str, float]:
+    """update_step_ms, train_step_ms, comm_ms_per_step, comm_fraction,
+    overlap_headroom_ms on the tiny config over all available devices."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import bench
+    from ddlpc_tpu.models import build_model_from_experiment
+    from ddlpc_tpu.obs.comm import make_comm_probe
+    from ddlpc_tpu.parallel.mesh import make_mesh
+    from ddlpc_tpu.parallel.shard_update import (
+        StateLayout,
+        resolve_shard_update,
+    )
+    from ddlpc_tpu.parallel.train_step import (
+        create_train_state,
+        make_train_step,
+    )
+    from ddlpc_tpu.train.optim import build_optimizer
+
+    cfg = _tiny_cfg()
+    mesh = make_mesh(cfg.parallel)
+    n = mesh.shape["data"]
+    model = build_model_from_experiment(cfg)
+    tx = build_optimizer(cfg.train)
+    h, w = cfg.data.image_size
+    state = create_train_state(model, tx, jax.random.key(0), (1, h, w, 3))
+    sharded = resolve_shard_update(
+        "auto", cfg.compression, n, spatial=False,
+        grad_clip_norm=cfg.train.grad_clip_norm,
+    )
+    layout = StateLayout(
+        "zero1" if sharded else "replicated", tx, state, mesh, "data"
+    )
+    param_shapes = jax.tree.map(
+        lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), state.params
+    )
+    state = layout.place(state)
+    update_ms = bench.measure_update_ms(
+        tx, mesh, cfg.compression, state, sharded, rounds=rounds
+    )
+
+    probe = make_comm_probe(
+        mesh, cfg.compression, param_shapes, scatter=sharded,
+        seed=cfg.train.seed,
+    )
+    comm_ms = min(probe() for _ in range(max(rounds, 2))) * 1e3
+
+    step = make_train_step(
+        model, tx, mesh, cfg.compression, shard_update=sharded
+    )
+    A = cfg.train.sync_period
+    B = cfg.train.micro_batch_size * n
+    rng = np.random.default_rng(0)
+    images = jax.device_put(
+        rng.uniform(0, 1, (A, B, h, w, 3)).astype(np.float32),
+        NamedSharding(mesh, P(None, "data")),
+    )
+    labels = jax.device_put(
+        rng.integers(0, 6, (A, B, h, w)).astype(np.int32),
+        NamedSharding(mesh, P(None, "data")),
+    )
+    for _ in range(2):
+        state, metrics = step(state, images, labels)
+        float(metrics["loss"])
+    times = []
+    for _ in range(max(rounds, 3)):
+        t0 = time.perf_counter()
+        for _ in range(4):
+            state, metrics = step(state, images, labels)
+        float(metrics["loss"])
+        times.append((time.perf_counter() - t0) / 4)
+    step_ms = float(np.median(times)) * 1e3
+    frac = min(comm_ms / step_ms, 1.0) if step_ms > 0 else 0.0
+    return {
+        "update_step_ms": round(update_ms, 3),
+        "train_step_ms": round(step_ms, 3),
+        "comm_ms_per_step": round(comm_ms, 3),
+        "comm_fraction": round(frac, 4),
+        "overlap_headroom_ms": round(
+            max(min(comm_ms, step_ms - comm_ms), 0.0), 3
+        ),
+    }
+
+
+def arm_loader(rounds: int) -> Dict[str, float]:
+    """loader_tiles_per_s: the ShardedLoader gather→cast→upload path."""
+    import jax
+
+    from ddlpc_tpu.data import ShardedLoader, build_dataset
+    from ddlpc_tpu.parallel.mesh import make_mesh
+
+    cfg = _tiny_cfg()
+    train_ds, _ = build_dataset(cfg.data)
+    mesh = make_mesh(cfg.parallel)
+    n = mesh.shape["data"]
+    loader = ShardedLoader(
+        train_ds,
+        mesh,
+        global_micro_batch=2 * n,
+        sync_period=2,
+        shuffle=True,
+        seed=0,
+        data_axis="data",
+    )
+    best = 0.0
+    for r in range(max(rounds, 2)):
+        loader.set_epoch(r)
+        batches = 0
+        t0 = time.perf_counter()
+        for images, labels in loader:
+            jax.block_until_ready(images)
+            batches += 1
+        dt = time.perf_counter() - t0
+        if batches:
+            best = max(best, batches * loader.super_batch / dt)
+    return {"loader_tiles_per_s": round(best, 2)}
+
+
+def arm_serve(rounds: int) -> Dict[str, float]:
+    """serve_p99_ms: the closed-loop serving load on a tiny checkpoint."""
+    import tempfile
+
+    import serve_bench
+
+    with tempfile.TemporaryDirectory() as tmp:
+        workdir = os.path.join(tmp, "gate_serve_run")
+        serve_bench.make_tiny_run(workdir)
+        rec = serve_bench.run_load(
+            workdir, clients=2, requests=12, scene=40, max_batch=4,
+            max_wait_ms=2.0,
+        )
+    return {"serve_p99_ms": float(rec["value"])}
+
+
+def measure(args) -> Dict[str, float]:
+    measured: Dict[str, float] = {}
+    if not args.skip_step:
+        measured.update(arm_step_and_comm(args.rounds))
+    if not args.skip_loader:
+        measured.update(arm_loader(args.rounds))
+    if not args.skip_serve:
+        measured.update(arm_serve(args.rounds))
+    return measured
+
+
+def build_baseline(measured: Dict[str, float]) -> dict:
+    import jax
+
+    metrics = {}
+    for name, spec in GATED.items():
+        if name in measured:
+            metrics[name] = dict(value=measured[name], **spec)
+    return {
+        "schema": BASELINE_SCHEMA,
+        "generated_by": "scripts/perf_gate.py --update-baseline",
+        "env": {
+            "backend": jax.default_backend(),
+            "devices": len(jax.devices()),
+            "host_cores": os.cpu_count(),
+        },
+        "metrics": metrics,
+        # The step-attribution numbers the comm/compute-overlap work is
+        # judged against (informational context for the gated ratios).
+        "attribution": {
+            k: v
+            for k, v in measured.items()
+            if k in ("comm_ms_per_step", "overlap_headroom_ms")
+        },
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="measure and rewrite the baseline file")
+    ap.add_argument("--smoke", action="store_true",
+                    help="validate baseline + gate logic, no measurement")
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--devices", type=int, default=0,
+                    help="force an N-device virtual CPU mesh (0 = as-is)")
+    ap.add_argument("--skip-step", action="store_true")
+    ap.add_argument("--skip-loader", action="store_true")
+    ap.add_argument("--skip-serve", action="store_true")
+    ap.add_argument("--inject", action="append", default=[],
+                    metavar="METRIC=FACTOR",
+                    help="multiply a measured value before comparing "
+                    "(regression-injection demonstration; repeatable)")
+    ap.add_argument("--inject-only", action="store_true",
+                    help="with --inject: no measurement — start from the "
+                    "baseline's own values and apply the factors, so the "
+                    "demonstration isolates gate sensitivity from host "
+                    "noise")
+    ap.add_argument("--out", default="", help="write measured values as JSON")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        return smoke(args.baseline)
+
+    inject: Dict[str, float] = {}
+    for spec in args.inject:
+        if "=" not in spec:
+            ap.error(f"--inject takes METRIC=FACTOR, got {spec!r}")
+        k, _, v = spec.partition("=")
+        if k not in GATED:
+            # A typo'd metric would be silently ignored by compare() and
+            # the demonstration would print PASS — invert of its meaning.
+            ap.error(
+                f"--inject: unknown metric {k!r} (gated metrics: "
+                f"{', '.join(sorted(GATED))})"
+            )
+        inject[k] = float(v)
+
+    if args.inject_only:
+        if not inject:
+            ap.error("--inject-only needs at least one --inject METRIC=FACTOR")
+        try:
+            with open(args.baseline) as f:
+                baseline = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"perf_gate: cannot load baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+        errs = validate_baseline(baseline)
+        if errs:
+            for e in errs:
+                print(f"perf_gate: {e}", file=sys.stderr)
+            return 2
+        measured = {
+            n: float(s["value"]) for n, s in baseline["metrics"].items()
+        }
+        failures = compare(baseline["metrics"], measured, inject=inject)
+        for fail in failures:
+            print(f"perf_gate: {fail}")
+        if failures:
+            return 1
+        print("perf_gate: PASS (injected factors inside tolerance)")
+        return 0
+
+    if args.devices:
+        from ddlpc_tpu.utils.compat import force_cpu_devices
+
+        force_cpu_devices(args.devices)
+
+    measured = measure(args)
+    print(json.dumps({"measured": measured}))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(measured, f, indent=2)
+
+    if args.update_baseline:
+        baseline = build_baseline(measured)
+        os.makedirs(os.path.dirname(args.baseline) or ".", exist_ok=True)
+        with open(args.baseline, "w") as f:
+            json.dump(baseline, f, indent=2)
+            f.write("\n")
+        print(f"perf_gate: baseline written to {args.baseline}")
+        return 0
+
+    try:
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"perf_gate: cannot load baseline {args.baseline}: {e}",
+              file=sys.stderr)
+        return 2
+    errs = validate_baseline(baseline)
+    if errs:
+        for e in errs:
+            print(f"perf_gate: {e}", file=sys.stderr)
+        return 2
+    failures = compare(baseline["metrics"], measured, inject=inject)
+    for fail in failures:
+        print(f"perf_gate: {fail}")
+    if failures:
+        return 1
+    compared = sorted(set(baseline["metrics"]) & set(measured))
+    print(f"perf_gate: PASS ({', '.join(compared)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

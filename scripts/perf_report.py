@@ -1,0 +1,158 @@
+"""Render a run's step-time attribution table from its JSONL streams.
+
+The trainer's performance accounting (obs/flops.py, obs/comm.py;
+``TrainConfig.perf_accounting``) appends cumulative ``kind="perf"`` and
+``kind="comm"`` records to ``metrics.jsonl`` every epoch.  This tool
+reads the newest of each and renders where the wall-clock went —
+
+    category          seconds    share
+    compute (step)     41.320    0.816
+    data wait           4.210    0.083
+    eval                2.470    0.049
+    checkpoint          0.910    0.018
+    restart             0.000    0.000
+    other               1.730    0.034
+    wall               50.640    1.000
+
+— plus the goodput/MFU headline and, when the comm probe sampled, the
+per-step comm fraction and overlap headroom.  Reads only committed JSONL
+streams: it works on a live run, a finished one, or an artifact copied
+off a pod.
+
+Usage:
+    python scripts/perf_report.py RUN_WORKDIR [--json out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def last_records(path: str) -> Dict[str, dict]:
+    """Newest record per ``kind`` from one JSONL stream (torn/invalid
+    lines skipped — live runs append concurrently)."""
+    out: Dict[str, dict] = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out[str(rec.get("kind", "train"))] = rec
+    except OSError:
+        pass
+    return out
+
+
+def attribution(perf: dict) -> List[dict]:
+    """Ordered (category, seconds, share) rows from a kind="perf" record."""
+    wall = float(perf.get("wall_s") or 0.0)
+    rows = [("compute (step)", float(perf.get("productive_s") or 0.0))]
+    for key, val in sorted(perf.items()):
+        if key.startswith("debit_") and key.endswith("_s"):
+            name = key[len("debit_"):-2]
+            rows.append(
+                ({"data": "data wait"}.get(name, name), float(val or 0.0))
+            )
+    rows.append(("other", float(perf.get("other_s") or 0.0)))
+    return [
+        {
+            "category": name,
+            "seconds": round(secs, 3),
+            "share": round(secs / wall, 4) if wall > 0 else None,
+        }
+        for name, secs in rows
+    ]
+
+
+def build_report(workdir: str) -> dict:
+    recs = last_records(os.path.join(workdir, "metrics.jsonl"))
+    perf = recs.get("perf")
+    if perf is None:
+        raise SystemExit(
+            f"perf_report: no kind=\"perf\" records in "
+            f"{workdir}/metrics.jsonl — run with "
+            f"TrainConfig.perf_accounting=true (the default)"
+        )
+    comm = recs.get("comm", {})
+    train = recs.get("train", {})
+    report = {
+        "workdir": workdir,
+        "wall_s": perf.get("wall_s"),
+        "steps": perf.get("steps"),
+        "goodput": perf.get("goodput"),
+        "mfu": perf.get("mfu"),
+        "peak_flops_assumed": perf.get("peak_flops_assumed"),
+        "step_time_s": perf.get("step_time_s") or train.get("step_time_s"),
+        "attribution": attribution(perf),
+    }
+    for key in ("comm_fraction", "comm_s_per_step", "overlap_headroom_s",
+                "variant"):
+        if key in comm:
+            report[key] = comm[key]
+    bytes_rows = {
+        k: v for k, v in comm.items()
+        if k.endswith(("_bytes_pre_per_step", "_bytes_post_per_step",
+                       "_compression_ratio", "_codec"))
+    }
+    if bytes_rows:
+        report["comm_bytes"] = bytes_rows
+    return report
+
+
+def render(report: dict) -> str:
+    lines = [
+        f"step-time attribution for {report['workdir']} "
+        f"({report.get('steps', '?')} steps, wall "
+        f"{report.get('wall_s', 0.0):.1f}s)",
+        f"  {'category':<16} {'seconds':>10} {'share':>7}",
+    ]
+    for row in report["attribution"]:
+        share = f"{row['share']:.3f}" if row["share"] is not None else "-"
+        lines.append(
+            f"  {row['category']:<16} {row['seconds']:>10.3f} {share:>7}"
+        )
+    wall = report.get("wall_s") or 0.0
+    lines.append(f"  {'wall':<16} {wall:>10.3f} {'1.000':>7}")
+    head = [f"goodput {report['goodput']:.3f}" if report.get("goodput")
+            is not None else "goodput -"]
+    if report.get("mfu") is not None:
+        head.append(
+            f"mfu {report['mfu']:.4f}"
+            + (" (assumed peak)" if report.get("peak_flops_assumed") else "")
+        )
+    if report.get("comm_fraction") is not None:
+        head.append(
+            f"comm fraction {report['comm_fraction']:.3f} "
+            f"(overlap headroom "
+            f"{1e3 * (report.get('overlap_headroom_s') or 0.0):.1f} ms/step)"
+        )
+    lines.append("  ".join(head))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("workdir", help="run workdir holding metrics.jsonl")
+    ap.add_argument("--json", default="", help="also write the report JSON")
+    args = ap.parse_args(argv)
+
+    report = build_report(args.workdir)
+    print(render(report))
+    if args.json:
+        os.makedirs(os.path.dirname(args.json) or ".", exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,91 +47,12 @@ import numpy as np
 from jax import lax
 
 from ddlpc_tpu.config import ExperimentConfig
-from ddlpc_tpu.models import build_model
-from ddlpc_tpu.ops.losses import softmax_cross_entropy
 
-
-# --------------------------------------------------------------------------
-# 1. Collect conv ops from the executed program
-# --------------------------------------------------------------------------
-
-
-def _sub_jaxprs(params):
-    for v in params.values():
-        if isinstance(v, jax.extend.core.ClosedJaxpr):
-            yield v.jaxpr
-        elif hasattr(v, "eqns"):  # raw Jaxpr
-            yield v
-        elif isinstance(v, (list, tuple)):
-            for q in v:
-                if isinstance(q, jax.extend.core.ClosedJaxpr):
-                    yield q.jaxpr
-                elif hasattr(q, "eqns"):
-                    yield q
-
-
-def iter_eqns(jaxpr):
-    for eqn in jaxpr.eqns:
-        yield eqn
-        yield from (e for sub in _sub_jaxprs(eqn.params) for e in iter_eqns(sub))
-
-
-def conv_flops(eqn) -> int:
-    """2 * output_elements * KH * KW * Cin_per_group (MACs x 2)."""
-    out = eqn.outvars[0].aval.shape
-    rhs = eqn.invars[1].aval.shape
-    dn = eqn.params["dimension_numbers"]
-    cin_per_group = rhs[dn.rhs_spec[1]]
-    k_spatial = int(np.prod([rhs[d] for d in dn.rhs_spec[2:]]))
-    return 2 * int(np.prod(out)) * k_spatial * cin_per_group
-
-
-def collect_convs(cfg: ExperimentConfig, micro_batch: int):
-    """Unique conv signatures (with counts) in one micro-batch fwd+bwd."""
-    # No norm_axis_name: sync-BN's pmean needs a mesh axis and does not
-    # change any conv shape — the roofline traces the per-device program.
-    model = build_model(cfg.model)
-    h, w = cfg.data.image_size
-    x = jnp.zeros((micro_batch, h, w, 3), jnp.float32)
-    y = jnp.zeros((micro_batch, h, w), jnp.int32)
-    variables = model.init(jax.random.key(0), x, train=False)
-
-    def loss_fn(params):
-        logits, _ = model.apply(
-            {"params": params, "batch_stats": variables.get("batch_stats", {})},
-            x,
-            train=True,
-            mutable=["batch_stats"],
-        )
-        return softmax_cross_entropy(logits, y, ignore_index=-1)
-
-    jaxpr = jax.make_jaxpr(jax.value_and_grad(loss_fn))(variables["params"])
-    convs = {}
-    for eqn in iter_eqns(jaxpr.jaxpr):
-        if eqn.primitive.name != "conv_general_dilated":
-            continue
-        lhs, rhs = (v.aval for v in eqn.invars[:2])
-        dn = eqn.params["dimension_numbers"]
-        key = (
-            tuple(lhs.shape),
-            str(lhs.dtype),
-            tuple(rhs.shape),
-            str(rhs.dtype),
-            tuple(eqn.params["window_strides"]),
-            tuple(eqn.params["lhs_dilation"]),
-            tuple(eqn.params["rhs_dilation"]),
-            tuple(map(tuple, eqn.params["padding"])),
-            eqn.params["feature_group_count"],
-            # The actual layout specs: fwd convs are NHWC/HWIO but the
-            # weight-gradient convs XLA derives contract over batch with
-            # transposed specs — reconstruction from a fixed layout string
-            # would measure a different program.
-            (tuple(dn.lhs_spec), tuple(dn.rhs_spec), tuple(dn.out_spec)),
-        )
-        if key not in convs:
-            convs[key] = dict(eqn=eqn, count=0, flops=conv_flops(eqn))
-        convs[key]["count"] += 1
-    return convs
+# The jaxpr conv-walk lives in the package now (ddlpc_tpu/obs/flops.py) —
+# one implementation for this CLI and the trainer's live MFU gauges, the
+# same hoist PR 6 did for the xplane aggregation.  Re-exported here so
+# older imports of scripts.roofline keep working.
+from ddlpc_tpu.obs.flops import collect_convs, conv_flops, iter_eqns  # noqa: F401
 
 
 # --------------------------------------------------------------------------

@@ -92,8 +92,14 @@ def main() -> None:
     trainer.fit()
 
     records = [
-        json.loads(line)
-        for line in open(os.path.join(args.workdir, "metrics.jsonl"))
+        rec
+        for rec in (
+            json.loads(line)
+            for line in open(os.path.join(args.workdir, "metrics.jsonl"))
+        )
+        # kind-less training records only (perf/comm accounting records
+        # interleave into the same stream).
+        if "kind" not in rec
     ]
     steady = [r["tiles_per_s"] for r in records[1:]]  # epoch 0 = compile
     sustained = sum(steady) / len(steady)

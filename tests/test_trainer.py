@@ -60,9 +60,15 @@ def test_fit_trains_and_evaluates(run):
 
 def test_fit_writes_logs_and_config(run):
     workdir, _, _ = run
-    lines = open(os.path.join(workdir, "metrics.jsonl")).read().splitlines()
-    assert len(lines) == 2  # one per epoch
-    rec = json.loads(lines[-1])
+    records = [
+        json.loads(l)
+        for l in open(os.path.join(workdir, "metrics.jsonl")).read().splitlines()
+    ]
+    # kind-less training records, one per epoch (perf/comm accounting
+    # records interleave into the same stream, like alerts do).
+    train = [r for r in records if "kind" not in r]
+    assert len(train) == 2
+    rec = train[-1]
     assert "loss" in rec and "val_miou" in rec and "epoch_time_s" in rec
     assert os.path.exists(os.path.join(workdir, "metrics.txt"))
     cfg = json.load(open(os.path.join(workdir, "config.json")))
