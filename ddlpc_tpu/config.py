@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 from dataclasses import dataclass, field
 from typing import Any, Tuple
 
@@ -402,6 +403,13 @@ class ServeConfig:
     trace: bool = False
     # Default batched forwards per /debug/trace profiler capture.
     profile_steps: int = 8
+    # Graceful SIGTERM shutdown: max seconds to wait for in-flight HTTP
+    # requests to finish writing their responses before exiting anyway.
+    drain_timeout_s: float = 30.0
+    # Where serve_metrics.jsonl (and traces) land; "" = workdir.  The
+    # fleet gives each replica its own dir so N processes never interleave
+    # one JSONL stream.
+    metrics_dir: str = ""
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -425,6 +433,113 @@ class ServeConfig:
 
     def replace(self, **kwargs) -> "ServeConfig":
         return dataclasses.replace(self, **kwargs)
+
+
+@dataclass(frozen=True)
+class FleetConfig:
+    """Fault-tolerant serving fleet (serve/fleet.py + serve/router.py).
+
+    One router process supervises ``replicas`` engine subprocesses (each a
+    ``python -m ddlpc_tpu.serve.server`` on an ephemeral port) and
+    dispatches tiles by per-replica health and occupancy, with per-request
+    timeout → retry-on-another-replica (full-jitter backoff), hedged
+    requests for the tail, and a per-replica circuit breaker.  Rolling
+    hot-reload pushes a new checkpoint replica-by-replica
+    (drain → /reload → warmup → readmit) and falls back fleet-wide if any
+    replica's reload quarantines the blob (docs/SERVING.md "Fleet").
+    """
+
+    workdir: str = "runs/default"  # training run every replica serves
+    # Router/supervisor state dir (replica logs + port files, router.jsonl);
+    # "" = <workdir>/fleet.
+    fleet_dir: str = ""
+    host: str = "127.0.0.1"
+    port: int = 8570  # router HTTP port (0 = ephemeral)
+    replicas: int = 3
+    # Per-replica serve knobs, forwarded into each replica's ServeConfig.
+    max_batch: int = 8
+    max_wait_ms: float = 5.0
+    queue_limit: int = 64
+    deadline_ms: float = 2000.0
+    overlap: float = 0.25
+    # Dispatch: per-attempt replica timeout; a timed-out/failed attempt
+    # retries on a DIFFERENT replica up to ``retries`` times with
+    # full-jitter backoff; after ``hedge_ms`` without a response a
+    # duplicate is hedged to a second replica (first answer wins, the
+    # loser is cancelled).  0 disables hedging.
+    request_timeout_ms: float = 4000.0
+    retries: int = 2
+    retry_backoff_ms: float = 25.0
+    hedge_ms: float = 1000.0
+    hedge_max: int = 1
+    # Per-replica circuit breaker: error rate over the last
+    # ``breaker_window`` outcomes (once ``breaker_min_samples`` seen)
+    # >= ``breaker_error_rate`` opens the circuit; after
+    # ``breaker_cooldown_s`` it half-opens and admits
+    # ``breaker_half_open_probes`` probes; ``breaker_close_after``
+    # consecutive probe successes re-close it, any probe failure re-opens.
+    breaker_window: int = 16
+    breaker_min_samples: int = 8
+    breaker_error_rate: float = 0.5
+    breaker_cooldown_s: float = 2.0
+    breaker_half_open_probes: int = 1
+    breaker_close_after: int = 2
+    # Health scraping (one cheap /healthz per replica per interval):
+    # ``unhealthy_after`` consecutive failed scrapes take a replica out of
+    # dispatch until a scrape succeeds again.
+    scrape_every_s: float = 1.0
+    scrape_timeout_s: float = 2.0
+    unhealthy_after: int = 3
+    # Drain / rolling reload.
+    drain_timeout_s: float = 30.0
+    warmup_timeout_s: float = 180.0  # replica readiness deadline per (re)launch
+    # Replica supervision (resilience/supervisor.py RestartPolicy).
+    max_restarts: int = 100
+    crash_loop_limit: int = 3
+    backoff_base_s: float = 0.5
+    backoff_cap_s: float = 30.0
+    metrics_every_s: float = 10.0  # router.jsonl snapshot cadence; 0 = off
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_json(self, indent: int = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "FleetConfig":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - fields
+        if unknown:
+            raise ValueError(
+                f"unknown config key FleetConfig.{sorted(unknown)[0]}"
+            )
+        return cls(**d)
+
+    @classmethod
+    def from_json(cls, s: str) -> "FleetConfig":
+        return cls.from_dict(json.loads(s))
+
+    def replace(self, **kwargs) -> "FleetConfig":
+        return dataclasses.replace(self, **kwargs)
+
+    def resolved_fleet_dir(self) -> str:
+        return self.fleet_dir or os.path.join(self.workdir, "fleet")
+
+    def replica_serve_config(self, metrics_dir: str = "") -> "ServeConfig":
+        """The ServeConfig one replica subprocess runs with."""
+        return ServeConfig(
+            workdir=self.workdir,
+            host=self.host,
+            port=0,  # ephemeral; the supervisor reads the port file
+            max_batch=self.max_batch,
+            max_wait_ms=self.max_wait_ms,
+            queue_limit=self.queue_limit,
+            deadline_ms=self.deadline_ms,
+            overlap=self.overlap,
+            drain_timeout_s=self.drain_timeout_s,
+            metrics_dir=metrics_dir,
+        )
 
 
 @dataclass(frozen=True)

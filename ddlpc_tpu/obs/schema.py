@@ -34,6 +34,8 @@ KNOWN_KINDS = frozenset(
         "supervisor_give_up",
         "perf",  # goodput/MFU accounting (obs/flops.py, per epoch)
         "comm",  # communication accounting (obs/comm.py)
+        "router",  # fleet router snapshots/events — router.jsonl (serve/router.py)
+        "fleet",  # replica supervision events — router.jsonl (serve/fleet.py)
     }
 )
 

@@ -18,11 +18,30 @@ A fault schedule is one string in ``DDLPC_CHAOS``, semicolon-separated:
                     re-raise-on-training-thread contract
   ``slow_loader:MS``  every data fetch sleeps MS milliseconds
 
+Serve-side faults (ISSUE 10), triggered by batched-forward count in the
+serving engine instead of optimizer steps:
+
+  ``serve_kill@N``    SIGKILL the serving process at its Nth batched
+                      forward — the replica-death case the fleet router
+                      must retry around
+  ``serve_stall@N[:S]``  sleep S seconds (default 60) inside the Nth
+                      forward — the response-stall case that must surface
+                      as a router timeout, not a hung client
+  ``serve_err@N[:K]`` raise :class:`ChaosFault` from forwards N..N+K-1
+                      (default K=1) — the error burst that must trip the
+                      router's per-replica circuit breaker
+  ``reload_corrupt@K``  before the Kth checkpoint hot-reload, flip one
+                      byte of the newest checkpoint blob — the reader
+                      quarantines it and falls back, which a rolling
+                      fleet reload must treat as a fleet-wide abort
+
 Step numbers count optimizer-step loop iterations **since process start**
 (a restarted process counts from 0 again — the supervisor's per-attempt
-``env_fn`` is how a schedule avoids re-killing itself forever).  One-shot
-faults fire at most once per process.  Injections print a ``[chaos]`` line
-to stderr so a survival report can be audited against the schedule.
+``env_fn`` is how a schedule avoids re-killing itself forever); serve
+triggers count batched forwards since process start the same way.
+One-shot faults fire at most once per process.  Injections print a
+``[chaos]`` line to stderr so a survival report can be audited against
+the schedule.
 
 Stdlib-only on purpose: ``train/checkpoint.py`` calls the checkpoint hooks
 and must not gain a heavyweight (or circular) import for a harness that is
@@ -49,6 +68,12 @@ class ChaosError(ValueError):
     typo'd schedule cannot silently run a chaos-free soak."""
 
 
+class ChaosFault(RuntimeError):
+    """An injected serve-side failure (``serve_err``): raised out of the
+    engine's forward so it rides the real error path — batcher fails the
+    batch, frontend answers 500, the router's breaker counts it."""
+
+
 def _log(msg: str) -> None:
     print(f"[chaos] {msg}", file=sys.stderr, flush=True)
 
@@ -58,7 +83,8 @@ class ChaosMonkey:
 
     KINDS = (
         "kill", "stall", "preempt", "nan", "flip_ckpt", "disk_full",
-        "slow_loader",
+        "slow_loader", "serve_kill", "serve_stall", "serve_err",
+        "reload_corrupt",
     )
 
     def __init__(self, spec: str):
@@ -66,10 +92,16 @@ class ChaosMonkey:
         # kind -> trigger (step or nth-event); stall also keeps a duration.
         self.step_faults: Dict[int, List[dict]] = {}
         self.ckpt_faults: Dict[str, int] = {}  # kind -> nth write (1-based)
+        # Serve-side: nth batched forward -> faults; nth reload -> corrupt.
+        self.serve_faults: Dict[int, List[dict]] = {}
+        self.reload_corrupt_at = 0  # 1-based reload count; 0 = unscheduled
         self.slow_loader_ms = 0.0
         self.fired: List[dict] = []
         self._nan_armed = False
         self._ckpt_writes = 0
+        self._serve_forwards = 0
+        self._reloads = 0
+        self._err_burst_left = 0
         for part in filter(None, (p.strip() for p in spec.split(";"))):
             self._parse(part)
 
@@ -97,6 +129,12 @@ class ChaosMonkey:
             raise ChaosError(f"bad trigger in chaos fault {part!r}")
         if kind in ("flip_ckpt", "disk_full"):
             self.ckpt_faults[kind] = n
+        elif kind == "reload_corrupt":
+            self.reload_corrupt_at = n
+        elif kind.startswith("serve_"):
+            self.serve_faults.setdefault(n, []).append(
+                {"kind": kind, "dur": dur}
+            )
         else:
             self.step_faults.setdefault(n, []).append(
                 {"kind": kind, "dur": dur}
@@ -174,6 +212,67 @@ class ChaosMonkey:
             _log(f"flipped byte {pos} of {path}")
         except OSError as e:
             _log(f"flip_ckpt failed on {path}: {e}")
+
+    # -- serve-side hooks (ISSUE 10) ----------------------------------------
+
+    def on_serve_forward(self) -> None:
+        """Called once per batched forward in the serving engine
+        (serve/engine.py:forward_windows).  ``serve_kill`` and
+        ``serve_stall`` act in place; ``serve_err`` arms a burst of
+        :class:`ChaosFault` raises covering this and the next K-1
+        forwards — the real 500 path the router's breaker must count."""
+        self._serve_forwards += 1
+        n = self._serve_forwards
+        for f in self.serve_faults.pop(n, ()):
+            kind = f["kind"]
+            self.fired.append({"kind": kind, "forward": n})
+            _log(f"{kind} at forward {n}")
+            if kind == "serve_kill":
+                os.kill(os.getpid(), signal.SIGKILL)
+            elif kind == "serve_stall":
+                time.sleep(f["dur"] if f["dur"] is not None else 60.0)
+            elif kind == "serve_err":
+                self._err_burst_left = int(f["dur"] or 1)
+        if self._err_burst_left > 0:
+            self._err_burst_left -= 1
+            raise ChaosFault(f"chaos: injected error burst (forward {n})")
+
+    def on_serve_reload(self, ckpt_dir: str) -> None:
+        """Called at the top of every checkpoint hot-reload; on the Kth,
+        flips one mid-file byte of the NEWEST live blob so the CRC reader
+        quarantines it and falls back — the corrupt-reload case a rolling
+        fleet update must abort on."""
+        self._reloads += 1
+        if self.reload_corrupt_at != self._reloads:
+            return
+        self.reload_corrupt_at = 0
+        from ddlpc_tpu.resilience.protocol import _CKPT_RE
+
+        try:
+            names = [n for n in os.listdir(ckpt_dir) if _CKPT_RE.match(n)]
+        except OSError as e:
+            _log(f"reload_corrupt: cannot list {ckpt_dir}: {e}")
+            return
+        if not names:
+            _log(f"reload_corrupt: no checkpoints in {ckpt_dir}")
+            return
+        newest = max(names, key=lambda n: int(_CKPT_RE.match(n).group(1)))
+        path = os.path.join(ckpt_dir, newest)
+        try:
+            size = os.path.getsize(path)
+            pos = size // 2
+            with open(path, "r+b") as f:
+                f.seek(pos)
+                b = f.read(1)
+                f.seek(pos)
+                f.write(bytes([b[0] ^ 0xFF]))
+            self.fired.append(
+                {"kind": "reload_corrupt", "path": path, "offset": pos,
+                 "reload": self._reloads}
+            )
+            _log(f"reload_corrupt: flipped byte {pos} of {path}")
+        except OSError as e:
+            _log(f"reload_corrupt failed on {path}: {e}")
 
 
 def active() -> Optional[ChaosMonkey]:

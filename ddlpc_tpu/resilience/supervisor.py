@@ -83,6 +83,72 @@ def classify_exit(returncode: int, breadcrumb: Optional[dict] = None) -> str:
     return "crash"
 
 
+class RestartPolicy:
+    """The backoff / crash-loop / give-up state machine, extracted so the
+    training :class:`Supervisor` and the serve fleet's ``ReplicaSupervisor``
+    (serve/fleet.py) restart things by ONE set of rules:
+
+    - full-jitter exponential backoff between restarts that made no
+      progress (``uniform(0, min(cap, base·2^(streak-1)))``);
+    - ``crash_loop_limit`` consecutive no-progress exits → give up;
+    - ``max_restarts`` total restarts → give up.
+
+    "Progress" is the caller's notion (the training supervisor: the newest
+    checkpoint step advanced or a graceful preemption completed; the fleet:
+    the replica became ready and served traffic since launch).  The policy
+    only tracks the streak.
+    """
+
+    def __init__(
+        self,
+        max_restarts: int = 100,
+        crash_loop_limit: int = 3,
+        backoff_base_s: float = 1.0,
+        backoff_cap_s: float = 60.0,
+        rng: Optional[random.Random] = None,
+    ):
+        if crash_loop_limit < 1:
+            raise ValueError(
+                f"crash_loop_limit must be >= 1, got {crash_loop_limit}"
+            )
+        self.max_restarts = int(max_restarts)
+        self.crash_loop_limit = int(crash_loop_limit)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.rng = rng if rng is not None else random.Random()
+        self.fail_streak = 0
+        self.attempts = 0  # exits recorded (== restarts granted so far + 1)
+
+    def backoff_s(self, fail_streak: int) -> float:
+        """Full-jitter exponential backoff for the Nth consecutive
+        no-progress failure (streak >= 1): uniform(0, min(cap, base·2^(N-1)))."""
+        if fail_streak <= 0:
+            return 0.0
+        ceiling = min(
+            self.backoff_cap_s,
+            self.backoff_base_s * (2.0 ** (fail_streak - 1)),
+        )
+        return self.rng.uniform(0.0, ceiling)
+
+    def record_exit(self, progressed: bool) -> str:
+        """Account one child exit; returns the decision:
+        ``"restart"`` | ``"give_up_crash_loop"`` | ``"give_up_budget"``."""
+        self.attempts += 1
+        if progressed:
+            self.fail_streak = 0
+        else:
+            self.fail_streak += 1
+        if self.fail_streak >= self.crash_loop_limit:
+            return "give_up_crash_loop"
+        if self.attempts > self.max_restarts:
+            return "give_up_budget"
+        return "restart"
+
+    def delay_s(self) -> float:
+        """The backoff to sleep before the restart just granted."""
+        return self.backoff_s(self.fail_streak)
+
+
 @dataclass
 class SupervisorResult:
     """What a supervision run amounted to."""
@@ -126,15 +192,18 @@ class Supervisor:
         popen: Callable[..., "subprocess.Popen"] = subprocess.Popen,
         echo: bool = True,
     ):
-        if crash_loop_limit < 1:
-            raise ValueError(f"crash_loop_limit must be >= 1, got {crash_loop_limit}")
         self.cmd = list(cmd)
         self.workdir = workdir
         self.ckpt_dir = ckpt_dir or os.path.join(workdir, "checkpoints")
-        self.max_restarts = int(max_restarts)
-        self.crash_loop_limit = int(crash_loop_limit)
-        self.backoff_base_s = float(backoff_base_s)
-        self.backoff_cap_s = float(backoff_cap_s)
+        self.policy = RestartPolicy(
+            max_restarts=max_restarts,
+            crash_loop_limit=crash_loop_limit,
+            backoff_base_s=backoff_base_s,
+            backoff_cap_s=backoff_cap_s,
+            rng=rng,
+        )
+        self.max_restarts = self.policy.max_restarts
+        self.crash_loop_limit = self.policy.crash_loop_limit
         self.env_fn = env_fn
         self.registry = registry if registry is not None else MetricsRegistry()
         self._restarts = self.registry.counter(
@@ -143,7 +212,6 @@ class Supervisor:
             labelnames=("cause",),
         )
         self._sleep = sleep
-        self._rng = rng if rng is not None else random.Random()
         self._popen = popen
         self.echo = echo
         self._stop = threading.Event()
@@ -182,21 +250,15 @@ class Supervisor:
                 pass
 
     def backoff_s(self, fail_streak: int) -> float:
-        """Full-jitter exponential backoff for the Nth consecutive
-        no-progress failure (streak >= 1): uniform(0, min(cap, base·2^(N-1)))."""
-        if fail_streak <= 0:
-            return 0.0
-        ceiling = min(
-            self.backoff_cap_s,
-            self.backoff_base_s * (2.0 ** (fail_streak - 1)),
-        )
-        return self._rng.uniform(0.0, ceiling)
+        """Full-jitter backoff for the Nth consecutive no-progress failure
+        (delegates to :class:`RestartPolicy` — one impl for both
+        supervisors)."""
+        return self.policy.backoff_s(fail_streak)
 
     # -- the loop -----------------------------------------------------------
 
     def run(self) -> SupervisorResult:
         attempt = 0
-        fail_streak = 0
         restarts: Dict[str, int] = {}
         installed = []
         if threading.current_thread() is threading.main_thread():
@@ -278,32 +340,18 @@ class Supervisor:
                     cause == "preempted"
                     and (crumb or {}).get("phase") == "preempted"
                 )
-                if progressed or graceful:
-                    fail_streak = 0
-                else:
-                    fail_streak += 1
-                if fail_streak >= self.crash_loop_limit:
-                    msg = (
-                        f"crash loop: {fail_streak} consecutive exits "
-                        f"({cause} last, rc {rc}) without checkpoint "
-                        f"progress (stuck at step {step_after}) — giving up. "
-                        f"Fix the run; restarting cannot."
-                    )
-                    self._say(msg)
-                    self._log(
-                        {
-                            "kind": "supervisor_give_up",
-                            "severity": "critical",
-                            "message": msg,
-                            "attempts": attempt,
-                            "rc": rc,
-                        }
-                    )
-                    return SupervisorResult(
-                        rc, attempt, restarts, gave_up=True, reason=msg
-                    )
-                if attempt > self.max_restarts:
-                    msg = f"restart budget exhausted ({self.max_restarts})"
+                decision = self.policy.record_exit(progressed or graceful)
+                if decision != "restart":
+                    if decision == "give_up_crash_loop":
+                        msg = (
+                            f"crash loop: {self.policy.fail_streak} "
+                            f"consecutive exits ({cause} last, rc {rc}) "
+                            f"without checkpoint progress (stuck at step "
+                            f"{step_after}) — giving up. "
+                            f"Fix the run; restarting cannot."
+                        )
+                    else:
+                        msg = f"restart budget exhausted ({self.max_restarts})"
                     self._say(msg)
                     self._log(
                         {
@@ -319,11 +367,11 @@ class Supervisor:
                     )
                 restarts[cause] = restarts.get(cause, 0) + 1
                 self._restarts.inc(cause=cause)
-                delay = self.backoff_s(fail_streak)
+                delay = self.policy.delay_s()
                 if delay > 0:
                     self._say(
                         f"backing off {delay:.2f}s (no-progress streak "
-                        f"{fail_streak})"
+                        f"{self.policy.fail_streak})"
                     )
                     self._sleep(delay)
         finally:

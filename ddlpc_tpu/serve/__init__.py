@@ -17,6 +17,12 @@ socket cluster; this package is the inference counterpart of that ambition
 - :mod:`server`   — stdlib ``http.server`` front end (``/healthz``,
                     ``/predict``, ``/metrics``, ``/reload``) over a
                     ``ServingFrontend`` that ties the three together.
+- :mod:`router`   — fleet routing tier: health/occupancy-aware dispatch
+                    across N replicas with retry, hedging, and
+                    per-replica circuit breakers (jax-free).
+- :mod:`fleet`    — replica supervision (launch/classify/backoff/relaunch
+                    via resilience/supervisor.py machinery) and the
+                    zero-downtime rolling hot-reload protocol.
 """
 
 from ddlpc_tpu.serve.batching import (  # noqa: F401
@@ -30,3 +36,10 @@ from ddlpc_tpu.serve.engine import (  # noqa: F401
     sliding_window_logits,
 )
 from ddlpc_tpu.serve.metrics import ServeMetrics  # noqa: F401
+from ddlpc_tpu.serve.router import (  # noqa: F401
+    CircuitBreaker,
+    FleetRouter,
+    HTTPReplicaClient,
+    ReplicaClient,
+    ReplicaError,
+)

@@ -25,6 +25,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ddlpc_tpu.resilience import chaos as _chaos_mod
+
 PyTree = object
 
 
@@ -309,6 +311,12 @@ class InferenceEngine:
         if workdir is None:
             raise ValueError("no workdir to reload from")
         ckpt_dir = os.path.join(workdir, "checkpoints")
+        monkey = _chaos_mod.active()
+        if monkey is not None:
+            # reload_corrupt@K: flip a byte of the newest blob before the
+            # Kth reload — the reader quarantines and falls back, and a
+            # rolling fleet reload must abort fleet-wide on that signal.
+            monkey.on_serve_reload(ckpt_dir)
         t0 = _time.perf_counter()
         state, meta = ckpt.restore_checkpoint(ckpt_dir, self.state, step=step)
         restore_s = _time.perf_counter() - t0
@@ -362,6 +370,13 @@ class InferenceEngine:
         n = len(windows)
         if n == 0:
             raise ValueError("forward_windows needs at least one window")
+        monkey = _chaos_mod.active()
+        if monkey is not None:
+            # Serve-side fault injection (resilience/chaos.py): kill, stall,
+            # or raise here so the injected failure rides the REAL error
+            # path — batcher fails the batch, frontend answers 500, the
+            # fleet router's breaker counts it.  Inert when unset.
+            monkey.on_serve_forward()
         state = self.state  # one snapshot: never mixes reload versions
         outs = []
         for i in range(0, n, self.max_bucket):

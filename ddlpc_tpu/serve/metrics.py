@@ -125,6 +125,15 @@ class ServeMetrics:
 
     # ---- readout -----------------------------------------------------------
 
+    def occupancy(self) -> Optional[float]:
+        """Windowed mean batch occupancy (None before the first batch).
+
+        Cheap enough for every ``/healthz`` — the fleet router's
+        occupancy-aware dispatch scrapes this once per second per replica,
+        so it must not pay the full ``snapshot()`` percentile pass."""
+        with self._lock:
+            return float(np.mean(self._occ)) if self._occ else None
+
     def percentiles_ms(self) -> Dict[str, Optional[float]]:
         with self._lock:
             lat = list(self._lat)
@@ -155,9 +164,7 @@ class ServeMetrics:
                 self._last_t = now
                 self._last_requests = self.requests
                 self._last_tiles = self.tiles
-            occupancy = (
-                float(np.mean(self._occ)) if self._occ else None
-            )
+            occupancy = float(np.mean(self._occ)) if self._occ else None
             return {
                 "kind": "serve",
                 **pct,

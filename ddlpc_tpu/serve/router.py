@@ -1,0 +1,997 @@
+"""Fleet routing frontend: health-aware dispatch, retry, hedging, breakers.
+
+The single-process serving stack (server.py) has no answer to a replica
+dying, hanging, or reloading mid-traffic; this module is the routing tier
+that makes a FLEET of those processes look like one reliable endpoint
+(ISSUE 10 tentpole; ROADMAP "multi-replica router" item):
+
+- **occupancy-aware dispatch** — every request goes to the eligible
+  replica with the least work (router-side in-flight + the queue depth
+  scraped from each replica's ``/healthz``, which carries queue depth and
+  batch occupancy exactly so this tier never parses full ``/metrics``);
+- **retry on another replica** — a per-attempt timeout or a 5xx answer
+  retries on a *different* replica with full-jitter backoff
+  (``uniform(0, base·2^(attempt-1))`` — the supervisor's backoff shape at
+  request scale);
+- **hedged requests** — after ``hedge_ms`` without an answer a duplicate
+  is dispatched to a second replica; the first answer wins and the loser
+  is cancelled (fake replicas honor the cancel event; HTTP losers get
+  their connection closed under them);
+- **per-replica circuit breaker** — error-rate latch with half-open
+  probing, the ``obs/health.py`` latch/re-arm pattern applied to a
+  replica instead of a queue: trip open on a sustained error rate, admit
+  bounded probes after a cooldown, close on consecutive probe successes;
+- **graceful drain** — stop dispatching to one replica, wait for its
+  in-flight requests to finish; the primitive under both replica restart
+  and the rolling hot-reload (serve/fleet.py).
+
+Transport is abstracted behind :class:`ReplicaClient` so the routing
+logic unit-tests against in-process fakes; :class:`HTTPReplicaClient` is
+the real one (stdlib ``http.client``, one connection per attempt —
+serving is engine-bound, not socket-bound).  Everything the router does
+is accounted: ``ddlpc_router_*`` metrics on the registry and flat
+``kind="router"`` records on ``<fleet_dir>/router.jsonl``.
+
+Deliberately jax-free: the router process babysits replicas that pay the
+jax import; it must never pay one itself.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import math
+import queue
+import random
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ddlpc_tpu.config import FleetConfig
+from ddlpc_tpu.obs.registry import MetricsRegistry
+
+Response = Tuple[int, str, bytes]  # (status, content-type, body)
+
+
+class ReplicaError(RuntimeError):
+    """Transport-level attempt failure: connect refused, socket timeout,
+    torn read — anything that never produced an HTTP status."""
+
+
+class NoReplicasAvailable(RuntimeError):
+    """No eligible replica (all dead, draining, or breaker-open)."""
+
+
+def _percentile(sorted_vals: Sequence[float], q: float) -> Optional[float]:
+    """np.percentile(interpolation='linear') without numpy — the router
+    stays light enough to import in a jax-free supervisor process."""
+    if not sorted_vals:
+        return None
+    k = (len(sorted_vals) - 1) * q / 100.0
+    f, c = math.floor(k), math.ceil(k)
+    if f == c:
+        return float(sorted_vals[int(k)])
+    return float(sorted_vals[f] * (c - k) + sorted_vals[c] * (k - f))
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class CircuitBreaker:
+    """Per-replica error-rate latch with half-open probing.
+
+    closed → (error rate ≥ ``error_rate`` over the last ``window``
+    outcomes, once ``min_samples`` seen) → open → (``cooldown_s``
+    elapsed) → half_open → (``close_after`` consecutive probe successes)
+    → closed; any half-open probe failure re-opens.  The latch/re-arm
+    shape is ``obs/health.py:QueueSaturationDetector``'s, applied to a
+    replica's error stream instead of a queue ratio.
+
+    ``acquire()`` is the side-effecting admission check (it performs the
+    open→half_open transition and counts probe slots); ``available()`` is
+    the side-effect-free filter the dispatcher uses to rank candidates.
+    """
+
+    def __init__(
+        self,
+        window: int = 16,
+        min_samples: int = 8,
+        error_rate: float = 0.5,
+        cooldown_s: float = 2.0,
+        half_open_probes: int = 1,
+        close_after: int = 2,
+        clock: Callable[[], float] = time.monotonic,
+        on_transition: Optional[Callable[[str], None]] = None,
+    ):
+        if not 0.0 < error_rate <= 1.0:
+            raise ValueError(f"error_rate must be in (0, 1], got {error_rate}")
+        self.window = int(window)
+        self.min_samples = int(min_samples)
+        self.error_rate = float(error_rate)
+        self.cooldown_s = float(cooldown_s)
+        self.half_open_probes = max(1, int(half_open_probes))
+        self.close_after = max(1, int(close_after))
+        self._clock = clock
+        self._on_transition = on_transition
+        self._lock = threading.Lock()
+        self.state = "closed"
+        self._outcomes: deque = deque(maxlen=self.window)
+        self._open_until = 0.0
+        self._probes_inflight = 0
+        self._probe_successes = 0
+
+    def _transition(self, to: str) -> None:
+        self.state = to
+        if self._on_transition is not None:
+            try:
+                self._on_transition(to)
+            except Exception:
+                pass  # accounting must never break dispatch
+
+    def available(self) -> bool:
+        """Could a request be admitted right now?  No side effects."""
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if self.state == "open":
+                return self._clock() >= self._open_until
+            return self._probes_inflight < self.half_open_probes
+
+    def acquire(self) -> bool:
+        """Admit one request; half-open admission consumes a probe slot."""
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if self.state == "open":
+                if self._clock() < self._open_until:
+                    return False
+                self._transition("half_open")
+                self._probes_inflight = 0
+                self._probe_successes = 0
+            if self._probes_inflight < self.half_open_probes:
+                self._probes_inflight += 1
+                return True
+            return False
+
+    def release(self) -> None:
+        """Give back an acquired admission WITHOUT an outcome (the attempt
+        was cancelled — a hedge/retry loser).  Without this, a cancelled
+        half-open probe would leak its slot and wedge the replica out of
+        rotation forever."""
+        with self._lock:
+            if self.state == "half_open":
+                self._probes_inflight = max(0, self._probes_inflight - 1)
+
+    def record(self, ok: bool) -> None:
+        """Account one completed attempt against this replica."""
+        with self._lock:
+            if self.state == "half_open":
+                self._probes_inflight = max(0, self._probes_inflight - 1)
+                if ok:
+                    self._probe_successes += 1
+                    if self._probe_successes >= self.close_after:
+                        self._outcomes.clear()
+                        self._transition("closed")
+                else:
+                    self._open_until = self._clock() + self.cooldown_s
+                    self._transition("open")
+                return
+            if self.state == "open":
+                return  # straggler from before the trip; already accounted
+            self._outcomes.append(bool(ok))
+            if len(self._outcomes) >= self.min_samples:
+                errors = sum(1 for o in self._outcomes if not o)
+                if errors / len(self._outcomes) >= self.error_rate:
+                    self._outcomes.clear()
+                    self._open_until = self._clock() + self.cooldown_s
+                    self._transition("open")
+
+
+# ---------------------------------------------------------------------------
+# replica clients (transport abstraction)
+# ---------------------------------------------------------------------------
+
+
+class ReplicaClient:
+    """What the router needs from one replica.  Subclasses: the HTTP
+    client below (real fleet) and in-process fakes (tests)."""
+
+    name: str = "?"
+
+    def predict(
+        self,
+        body: bytes,
+        query: str,
+        timeout_s: float,
+        cancel: Optional[threading.Event] = None,
+    ) -> Response:
+        raise NotImplementedError
+
+    def healthz(self, timeout_s: float) -> dict:
+        raise NotImplementedError
+
+    def reload(self, payload: dict, timeout_s: float) -> Tuple[int, dict]:
+        raise NotImplementedError
+
+
+class HTTPReplicaClient(ReplicaClient):
+    """stdlib http.client transport: one connection per attempt.
+
+    ``cancel`` support is real but blunt: the router closes the attempt's
+    connection from the winning thread, which fails the loser's blocked
+    read immediately instead of letting it run to its socket timeout.
+    """
+
+    def __init__(self, name: str, host: str, port: int):
+        self.name = name
+        self.host = host
+        self.port = int(port)
+        # Live connections keyed by their attempt's cancel token, so a
+        # cancel closes ONLY that attempt's socket — this client is shared
+        # by every dispatch thread and the scrape loop, and tearing down a
+        # sibling request's healthy connection would inject false failures
+        # into the breaker.
+        self._conns: Dict[int, http.client.HTTPConnection] = {}
+        self._conns_lock = threading.Lock()
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[bytes],
+        timeout_s: float,
+        headers: Optional[dict] = None,
+        cancel: Optional[threading.Event] = None,
+    ) -> Response:
+        conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=timeout_s
+        )
+        key = id(cancel) if cancel is not None else None
+        if key is not None:
+            with self._conns_lock:
+                self._conns[key] = conn
+        try:
+            conn.request(method, path, body=body, headers=headers or {})
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, resp.getheader("Content-Type", ""), data
+        except Exception as e:
+            raise ReplicaError(f"{self.name}: {type(e).__name__}: {e}") from e
+        finally:
+            if key is not None:
+                with self._conns_lock:
+                    self._conns.pop(key, None)
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def cancel_attempt(self, cancel: threading.Event) -> None:
+        """Close the one connection registered under this attempt's cancel
+        token: its blocked read fails immediately, nobody else's does."""
+        with self._conns_lock:
+            conn = self._conns.get(id(cancel))
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def predict(self, body, query, timeout_s, cancel=None) -> Response:
+        path = "/predict" + (f"?{query}" if query else "")
+        return self._request(
+            "POST", path, body, timeout_s,
+            headers={"Content-Type": "application/x-npy"},
+            cancel=cancel,
+        )
+
+    def healthz(self, timeout_s: float) -> dict:
+        status, _, body = self._request("GET", "/healthz", None, timeout_s)
+        try:
+            h = json.loads(body)
+        except ValueError:
+            raise ReplicaError(f"{self.name}: /healthz returned non-JSON")
+        if not isinstance(h, dict):
+            raise ReplicaError(f"{self.name}: /healthz returned {type(h)}")
+        return h
+
+    def reload(self, payload: dict, timeout_s: float) -> Tuple[int, dict]:
+        status, _, body = self._request(
+            "POST", "/reload", json.dumps(payload).encode(), timeout_s,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            meta = json.loads(body) if body else {}
+        except ValueError:
+            meta = {"error": "non-JSON /reload response"}
+        return status, meta
+
+
+# ---------------------------------------------------------------------------
+# router metrics
+# ---------------------------------------------------------------------------
+
+
+class RouterMetrics:
+    """Counters + windowed latency ring for the routing tier, published as
+    ``ddlpc_router_*`` on the registry and as flat ``kind="router"``
+    snapshots on router.jsonl.  The acceptance bar is that every retry,
+    hedge, and breaker transition is accounted — these counters are the
+    ledger the fleet soak audits its fault schedule against."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 window: int = 4096):
+        self._lock = threading.Lock()
+        self._lat = deque(maxlen=window)
+        self.requests = 0
+        self.errors_5xx = 0  # CLIENT-VISIBLE failures (the soak forbids them)
+        self.attempts = 0
+        self.retries = 0
+        self.hedges = 0
+        self.hedge_wins = 0
+        self.breaker_opens = 0
+        self.breaker_half_opens = 0
+        self.breaker_closes = 0
+        self.drains = 0
+        self.readmissions = 0
+        self.reloads_ok = 0
+        self.reloads_aborted = 0
+        self._t0 = time.monotonic()
+        self._last_t = self._t0
+        self._last_requests = 0
+        self._reg = None
+        if registry is not None:
+            self._reg = {
+                "requests": registry.counter(
+                    "ddlpc_router_requests_total",
+                    "Client requests answered by the router, by outcome.",
+                    labelnames=("outcome",),
+                ),
+                "attempts": registry.counter(
+                    "ddlpc_router_attempts_total",
+                    "Replica attempts dispatched, by replica and reason.",
+                    labelnames=("replica", "reason"),
+                ),
+                "retries": registry.counter(
+                    "ddlpc_router_retries_total",
+                    "Attempts re-dispatched to another replica, by cause.",
+                    labelnames=("cause",),
+                ),
+                "hedges": registry.counter(
+                    "ddlpc_router_hedges_total",
+                    "Duplicate attempts dispatched for the latency tail.",
+                ),
+                "hedge_wins": registry.counter(
+                    "ddlpc_router_hedge_wins_total",
+                    "Requests answered by the hedged attempt.",
+                ),
+                "breaker": registry.counter(
+                    "ddlpc_router_breaker_transitions_total",
+                    "Circuit-breaker transitions, by replica and new state.",
+                    labelnames=("replica", "to"),
+                ),
+                "drains": registry.counter(
+                    "ddlpc_router_drains_total",
+                    "Replica drains completed (restart or rolling reload).",
+                ),
+                "reloads": registry.counter(
+                    "ddlpc_router_reloads_total",
+                    "Rolling fleet reloads, by outcome.",
+                    labelnames=("outcome",),
+                ),
+                "latency": registry.histogram(
+                    "ddlpc_router_request_latency_seconds",
+                    "End-to-end routed request latency.",
+                ),
+                "ready": registry.gauge(
+                    "ddlpc_router_replicas_ready",
+                    "Replicas currently eligible for dispatch.",
+                ),
+            }
+
+    def record_request(self, latency_s: float, ok: bool) -> None:
+        with self._lock:
+            self.requests += 1
+            self._lat.append(float(latency_s))
+            if not ok:
+                self.errors_5xx += 1
+        if self._reg is not None:
+            self._reg["requests"].inc(outcome="ok" if ok else "error")
+            self._reg["latency"].observe(float(latency_s))
+
+    def record_attempt(self, replica: str, reason: str) -> None:
+        with self._lock:
+            self.attempts += 1
+        if self._reg is not None:
+            self._reg["attempts"].inc(replica=replica, reason=reason)
+
+    def record_retry(self, cause: str) -> None:
+        with self._lock:
+            self.retries += 1
+        if self._reg is not None:
+            self._reg["retries"].inc(cause=cause)
+
+    def record_hedge(self) -> None:
+        with self._lock:
+            self.hedges += 1
+        if self._reg is not None:
+            self._reg["hedges"].inc()
+
+    def record_hedge_win(self) -> None:
+        with self._lock:
+            self.hedge_wins += 1
+        if self._reg is not None:
+            self._reg["hedge_wins"].inc()
+
+    def record_breaker(self, replica: str, to: str) -> None:
+        with self._lock:
+            if to == "open":
+                self.breaker_opens += 1
+            elif to == "half_open":
+                self.breaker_half_opens += 1
+            else:
+                self.breaker_closes += 1
+        if self._reg is not None:
+            self._reg["breaker"].inc(replica=replica, to=to)
+
+    def record_drain(self) -> None:
+        with self._lock:
+            self.drains += 1
+        if self._reg is not None:
+            self._reg["drains"].inc()
+
+    def record_readmit(self) -> None:
+        with self._lock:
+            self.readmissions += 1
+
+    def record_reload(self, ok: bool) -> None:
+        with self._lock:
+            if ok:
+                self.reloads_ok += 1
+            else:
+                self.reloads_aborted += 1
+        if self._reg is not None:
+            self._reg["reloads"].inc(outcome="ok" if ok else "aborted")
+
+    def set_ready(self, n: int) -> None:
+        if self._reg is not None:
+            self._reg["ready"].set(n)
+
+    def snapshot(self, advance: bool = True) -> Dict[str, object]:
+        with self._lock:
+            now = time.monotonic()
+            dt = max(now - self._last_t, 1e-9)
+            rate = (self.requests - self._last_requests) / dt
+            if advance:
+                self._last_t = now
+                self._last_requests = self.requests
+            lat = sorted(self._lat)
+            return {
+                "kind": "router",
+                "requests": self.requests,
+                "errors_5xx": self.errors_5xx,
+                "attempts": self.attempts,
+                "retries": self.retries,
+                "hedges": self.hedges,
+                "hedge_wins": self.hedge_wins,
+                "breaker_opens": self.breaker_opens,
+                "breaker_half_opens": self.breaker_half_opens,
+                "breaker_closes": self.breaker_closes,
+                "drains": self.drains,
+                "readmissions": self.readmissions,
+                "reloads_ok": self.reloads_ok,
+                "reloads_aborted": self.reloads_aborted,
+                "p50_ms": _round(_percentile(lat, 50)),
+                "p95_ms": _round(_percentile(lat, 95)),
+                "p99_ms": _round(_percentile(lat, 99)),
+                "requests_per_sec": round(rate, 3),
+                "uptime_s": round(now - self._t0, 3),
+            }
+
+
+def _round(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(v * 1000.0, 3)
+
+
+# ---------------------------------------------------------------------------
+# the router
+# ---------------------------------------------------------------------------
+
+
+class _Replica:
+    """Router-side view of one replica: client + dispatch state."""
+
+    def __init__(self, name: str, client: ReplicaClient,
+                 breaker: CircuitBreaker):
+        self.name = name
+        self.client = client
+        self.breaker = breaker
+        self.ready = False  # supervisor-declared (process up + warmed)
+        self.draining = False  # router-declared (drain/reload in progress)
+        self.healthy = True  # scrape-declared (flips after N failed scrapes)
+        self.inflight = 0  # router-side attempts outstanding
+        self.queue_depth = 0  # scraped
+        self.occupancy: Optional[float] = None  # scraped
+        self.checkpoint_step: Optional[int] = None  # scraped
+        self.version: Optional[int] = None  # scraped
+        self.scrape_fail_streak = 0
+
+    def status(self) -> Dict[str, object]:
+        return {
+            "name": self.name,
+            "ready": self.ready,
+            "draining": self.draining,
+            "healthy": self.healthy,
+            "breaker": self.breaker.state,
+            "inflight": self.inflight,
+            "queue_depth": self.queue_depth,
+            "occupancy": self.occupancy,
+            "checkpoint_step": self.checkpoint_step,
+            "version": self.version,
+        }
+
+
+class _Attempt:
+    __slots__ = ("replica", "cancel", "reason", "outcome", "thread", "t0")
+
+    def __init__(self, replica: _Replica, reason: str):
+        self.replica = replica
+        self.reason = reason  # "primary" | "retry" | "hedge"
+        self.cancel = threading.Event()
+        self.outcome: Optional[Tuple[str, object]] = None
+        self.thread: Optional[threading.Thread] = None
+        self.t0 = time.monotonic()
+
+
+class FleetRouter:
+    """Dispatch requests across replicas; the fleet's one client-facing
+    brain.  Thread-safe; replicas come and go at runtime (the supervisor
+    registers them as they pass readiness and removes them when their
+    process dies)."""
+
+    def __init__(
+        self,
+        cfg: Optional[FleetConfig] = None,
+        registry: Optional[MetricsRegistry] = None,
+        logger=None,
+        rng: Optional[random.Random] = None,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.cfg = cfg or FleetConfig()
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.metrics = RouterMetrics(registry=self.registry)
+        self.logger = logger  # MetricsLogger(basename="router") or None
+        self._rng = rng if rng is not None else random.Random()
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, _Replica] = {}
+        self._rr = 0  # round-robin tiebreaker
+        self._drain_cond = threading.Condition(self._lock)
+        self._stop = threading.Event()
+        self._scraper: Optional[threading.Thread] = None
+        self._emitter: Optional[threading.Thread] = None
+
+    # -- replica registry ---------------------------------------------------
+
+    def _new_breaker(self, name: str) -> CircuitBreaker:
+        """ONE construction site: a readmitted replica's fresh breaker
+        must never drift from a freshly added one's."""
+        return CircuitBreaker(
+            window=self.cfg.breaker_window,
+            min_samples=self.cfg.breaker_min_samples,
+            error_rate=self.cfg.breaker_error_rate,
+            cooldown_s=self.cfg.breaker_cooldown_s,
+            half_open_probes=self.cfg.breaker_half_open_probes,
+            close_after=self.cfg.breaker_close_after,
+            on_transition=lambda to, n=name: self._on_breaker(n, to),
+        )
+
+    def add_replica(
+        self, name: str, client: ReplicaClient, ready: bool = True
+    ) -> None:
+        breaker = self._new_breaker(name)
+        with self._lock:
+            self._replicas[name] = _Replica(name, client, breaker)
+            self._replicas[name].ready = ready
+        self._log_event("replica_added", replica=name)
+        self._publish_ready()
+
+    def remove_replica(self, name: str) -> None:
+        with self._lock:
+            self._replicas.pop(name, None)
+        self._log_event("replica_removed", replica=name)
+        self._publish_ready()
+
+    def set_ready(self, name: str, ready: bool) -> None:
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is not None:
+                r.ready = ready
+                if ready:
+                    # A fresh process: forget the old error history.
+                    r.healthy = True
+                    r.scrape_fail_streak = 0
+        self._publish_ready()
+
+    def _on_breaker(self, name: str, to: str) -> None:
+        self.metrics.record_breaker(name, to)
+        self._log_event("breaker", replica=name, to=to)
+
+    def _publish_ready(self) -> None:
+        with self._lock:
+            n = sum(
+                1
+                for r in self._replicas.values()
+                if r.ready and not r.draining and r.healthy
+            )
+        self.metrics.set_ready(n)
+
+    def replica_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    def replica_status(self) -> List[Dict[str, object]]:
+        with self._lock:
+            return [r.status() for _, r in sorted(self._replicas.items())]
+
+    # -- scraping -----------------------------------------------------------
+
+    def scrape_once(self) -> None:
+        """One /healthz pass over the fleet: queue depth + occupancy feed
+        the dispatch score; ``unhealthy_after`` consecutive failures take
+        a replica out of rotation until a scrape succeeds again."""
+        with self._lock:
+            targets = [r for r in self._replicas.values() if r.ready]
+        for r in targets:
+            try:
+                h = r.client.healthz(self.cfg.scrape_timeout_s)
+            except Exception:
+                with self._lock:
+                    r.scrape_fail_streak += 1
+                    if r.scrape_fail_streak >= self.cfg.unhealthy_after:
+                        if r.healthy:
+                            self._log_event(
+                                "replica_unhealthy", replica=r.name,
+                                scrape_failures=r.scrape_fail_streak,
+                            )
+                        r.healthy = False
+                continue
+            with self._lock:
+                if not r.healthy:
+                    self._log_event("replica_recovered", replica=r.name)
+                r.scrape_fail_streak = 0
+                r.healthy = True
+                r.queue_depth = int(h.get("queue_depth") or 0)
+                occ = h.get("batch_occupancy")
+                r.occupancy = float(occ) if occ is not None else None
+                r.checkpoint_step = h.get("checkpoint_step")
+                r.version = h.get("version")
+                if h.get("status") == "draining":
+                    # The replica is shutting down on its own (SIGTERM):
+                    # treat like a router-side drain — no new dispatch.
+                    r.draining = True
+        self._publish_ready()
+
+    def start(self) -> "FleetRouter":
+        """Start the background scrape loop (and JSONL emitter if a
+        logger is attached)."""
+        if self._scraper is None and self.cfg.scrape_every_s > 0:
+            self._scraper = threading.Thread(
+                target=self._scrape_loop, name="router-scrape", daemon=True
+            )
+            self._scraper.start()
+        if (
+            self._emitter is None
+            and self.logger is not None
+            and self.cfg.metrics_every_s > 0
+        ):
+            self._emitter = threading.Thread(
+                target=self._emit_loop, name="router-metrics", daemon=True
+            )
+            self._emitter.start()
+        return self
+
+    def _scrape_loop(self) -> None:
+        while not self._stop.wait(self.cfg.scrape_every_s):
+            try:
+                self.scrape_once()
+            except Exception:
+                pass  # scraping must never kill the router
+
+    def _emit_loop(self) -> None:
+        while not self._stop.wait(self.cfg.metrics_every_s):
+            self.emit()
+
+    def emit(self) -> Dict[str, object]:
+        snap = self.metrics.snapshot()
+        if self.logger is not None:
+            self.logger.log(snap, echo=False)
+        return snap
+
+    def _log_event(self, event: str, **fields) -> None:
+        if self.logger is None:
+            return
+        try:
+            self.logger.log(
+                {"kind": "router", "event": event, **fields}, echo=False
+            )
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in (self._scraper, self._emitter):
+            if t is not None:
+                t.join(timeout=5.0)
+        if self.logger is not None:
+            self.emit()
+
+    # -- drain / readmit ----------------------------------------------------
+
+    def drain(self, name: str, timeout_s: Optional[float] = None) -> bool:
+        """Stop dispatching to ``name``, wait for its router-side in-flight
+        count to reach zero.  Returns False on timeout (work still in
+        flight — callers decide whether to proceed anyway)."""
+        timeout_s = (
+            self.cfg.drain_timeout_s if timeout_s is None else timeout_s
+        )
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is None:
+                return True
+            r.draining = True
+            while r.inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._publish_ready_locked()
+                    return False
+                self._drain_cond.wait(remaining)
+        self.metrics.record_drain()
+        self._log_event("drain", replica=name)
+        self._publish_ready()
+        return True
+
+    def _publish_ready_locked(self) -> None:
+        n = sum(
+            1
+            for r in self._replicas.values()
+            if r.ready and not r.draining and r.healthy
+        )
+        self.metrics.set_ready(n)
+
+    def readmit(self, name: str) -> None:
+        """Put a drained replica back into dispatch with a clean slate
+        (fresh weights or a fresh process deserve a fresh breaker)."""
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is None:
+                return
+            r.draining = False
+            r.breaker = self._new_breaker(name)
+        self.metrics.record_readmit()
+        self._log_event("readmit", replica=name)
+        self._publish_ready()
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _pick(self, exclude: Sequence[str]) -> Optional[_Replica]:
+        """Least-loaded eligible replica, preferring ones not in
+        ``exclude`` (a retry must land ELSEWHERE when anywhere else
+        exists).  Score = router-side in-flight + scraped queue depth."""
+        with self._lock:
+            def eligible(r: _Replica) -> bool:
+                return (
+                    r.ready
+                    and not r.draining
+                    and r.healthy
+                    and r.breaker.available()
+                )
+
+            ordered = [
+                self._replicas[n] for n in sorted(self._replicas)
+            ]
+            pool = [
+                r for r in ordered if eligible(r) and r.name not in exclude
+            ]
+            if not pool:
+                pool = [r for r in ordered if eligible(r)]
+            if not pool:
+                return None
+            # Rotate equal scores round-robin: stable sort by load keeps
+            # the rotated order among ties, so an idle fleet spreads
+            # instead of hammering whichever name sorts first.
+            self._rr += 1
+            k = self._rr % len(pool)
+            pool = pool[k:] + pool[:k]
+            pool.sort(key=lambda r: r.inflight + r.queue_depth)
+            for r in pool:
+                if r.breaker.acquire():
+                    r.inflight += 1
+                    return r
+            return None
+
+    def _finish_attempt(self, a: _Attempt, ok: Optional[bool]) -> None:
+        """Attempt bookkeeping, run by the ATTEMPT THREAD on completion —
+        not the dispatch loop, which may long since have answered the
+        client off a faster attempt.  ``ok=None`` means cancelled (a
+        hedge loser, a raced retry): the failure is the router's doing,
+        so it must not poison the replica's breaker — but the admission
+        it acquired (a half-open probe slot, possibly) must be
+        released."""
+        if ok is not None:
+            a.replica.breaker.record(ok)
+        else:
+            a.replica.breaker.release()
+        with self._lock:
+            a.replica.inflight = max(0, a.replica.inflight - 1)
+            self._drain_cond.notify_all()
+
+    def _launch(
+        self, body: bytes, query: str, reason: str,
+        exclude: Sequence[str], done: "queue.Queue[_Attempt]",
+    ) -> Optional[_Attempt]:
+        r = self._pick(exclude)
+        if r is None:
+            return None
+        a = _Attempt(r, reason)
+        self.metrics.record_attempt(r.name, reason)
+
+        def run() -> None:
+            ok: Optional[bool] = None
+            try:
+                resp = r.client.predict(
+                    body, query, self.cfg.request_timeout_ms / 1000.0,
+                    cancel=a.cancel,
+                )
+                a.outcome = ("response", resp)
+                ok = resp[0] < 500
+            except Exception as e:
+                a.outcome = ("fail", e)
+                ok = False
+            if ok is False and a.cancel.is_set():
+                ok = None  # cancelled loser: neutral for the breaker
+            self._finish_attempt(a, ok)
+            done.put(a)
+
+        a.thread = threading.Thread(
+            target=run, name=f"router-attempt-{r.name}", daemon=True
+        )
+        a.thread.start()
+        return a
+
+    @staticmethod
+    def _cancel(attempts: List[_Attempt], winner: Optional[_Attempt]) -> None:
+        for a in attempts:
+            if a is winner or a.outcome is not None:
+                continue
+            a.cancel.set()
+            cancel_hook = getattr(a.replica.client, "cancel_attempt", None)
+            if cancel_hook is not None:
+                try:
+                    cancel_hook(a.cancel)
+                except Exception:
+                    pass
+
+    def dispatch(self, body: bytes, query: str = "") -> Response:
+        """Route one request; ALWAYS returns a response.  A 5xx here means
+        every eligible replica (and every retry/hedge) failed — the
+        client-visible failure the fleet soak requires to be zero."""
+        t0 = time.monotonic()
+        status, ctype, payload = self._dispatch_inner(body, query)
+        ok = status < 500
+        self.metrics.record_request(time.monotonic() - t0, ok)
+        return status, ctype, payload
+
+    def _error(self, status: int, msg: str) -> Response:
+        return status, "application/json", json.dumps({"error": msg}).encode()
+
+    def _dispatch_inner(self, body: bytes, query: str) -> Response:
+        cfg = self.cfg
+        done: "queue.Queue[_Attempt]" = queue.Queue()
+        attempts: List[_Attempt] = []
+        tried: List[str] = []
+        retries_left = max(0, int(cfg.retries))
+        hedges_left = max(0, int(cfg.hedge_max)) if cfg.hedge_ms > 0 else 0
+
+        a = self._launch(body, query, "primary", tried, done)
+        if a is None:
+            self._log_event("no_replicas")
+            return self._error(503, "no replicas available")
+        attempts.append(a)
+        tried.append(a.replica.name)
+        pending = 1
+
+        while True:
+            timeout = cfg.hedge_ms / 1000.0 if hedges_left > 0 else None
+            try:
+                fin: _Attempt = done.get(timeout=timeout)
+            except queue.Empty:
+                # The tail case: nobody answered within hedge_ms — duplicate
+                # to another replica, first answer wins.
+                hedges_left -= 1
+                h = self._launch(body, query, "hedge", tried, done)
+                if h is not None:
+                    self.metrics.record_hedge()
+                    attempts.append(h)
+                    tried.append(h.replica.name)
+                    pending += 1
+                continue
+
+            pending -= 1
+            kind, val = fin.outcome  # type: ignore[misc]
+            if kind == "response":
+                st, ctype, payload = val  # type: ignore[misc]
+                if st < 500:
+                    # Success or a client-owned 4xx: either way the replica
+                    # answered coherently — return it, cancel the rest
+                    # (each loser's own thread does its bookkeeping).
+                    self._cancel(attempts, fin)
+                    if fin.reason == "hedge":
+                        self.metrics.record_hedge_win()
+                    return st, ctype, payload
+                cause = f"http_{st}"
+            else:
+                cause = (
+                    "cancelled" if fin.cancel.is_set() else "transport"
+                )
+            if fin.cancel.is_set():
+                # A cancelled loser finishing late is not a new failure;
+                # don't burn a retry on it.
+                if pending == 0 and retries_left == 0:
+                    return self._error(503, "all replica attempts failed")
+                continue
+
+            if retries_left > 0:
+                retries_left -= 1
+                self.metrics.record_retry(cause)
+                # Full-jitter backoff before the retry (attempt number =
+                # how many have failed so far).
+                n_failed = len([x for x in attempts if x.outcome is not None])
+                ceiling = min(
+                    cfg.retry_backoff_ms * (2.0 ** max(n_failed - 1, 0)),
+                    1000.0,
+                ) / 1000.0
+                delay = self._rng.uniform(0.0, ceiling)
+                if delay > 0:
+                    self._sleep(delay)
+                nxt = self._launch(body, query, "retry", tried, done)
+                if nxt is not None:
+                    attempts.append(nxt)
+                    tried.append(nxt.replica.name)
+                    pending += 1
+                    continue
+                # Nowhere to retry: fall through to waiting on any
+                # still-pending attempt, else fail.
+            if pending > 0:
+                continue
+            self._log_event(
+                "request_failed", attempts=len(attempts), last_cause=cause
+            )
+            return self._error(503, "all replica attempts failed")
+
+    # -- fleet health summary ----------------------------------------------
+
+    def healthz(self) -> dict:
+        statuses = self.replica_status()
+        ready = [
+            s
+            for s in statuses
+            if s["ready"] and not s["draining"] and s["healthy"]
+        ]
+        return {
+            "status": "ok" if ready else "unavailable",
+            "replicas": len(statuses),
+            "ready": len(ready),
+            "checkpoint_steps": sorted(
+                {
+                    s["checkpoint_step"]
+                    for s in statuses
+                    if s["checkpoint_step"] is not None
+                }
+            ),
+            "replica_status": statuses,
+        }

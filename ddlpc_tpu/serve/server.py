@@ -211,8 +211,12 @@ class ServingFrontend:
             np.int32
         )
 
-    def reload(self, workdir: Optional[str] = None) -> dict:
+    def reload(self, workdir: Optional[str] = None, step=None) -> dict:
         """Hot-reload; NEVER raises (ISSUE 7 satellite).
+
+        ``step`` pins an explicit checkpoint step (the fleet's rolling-
+        reload rollback uses it to push every replica back to the old
+        weights); default is the newest.
 
         The checkpoint reader already quarantines a corrupt newest blob and
         falls back to the next-newest (train/checkpoint.py); this catch is
@@ -223,7 +227,7 @@ class ServingFrontend:
         (the restore runs off-lock BEFORE the reference swap).
         """
         try:
-            meta = self.engine.reload(workdir)
+            meta = self.engine.reload(workdir, step=step)
         except Exception as e:
             err = f"{type(e).__name__}: {e}"
             self.last_reload_error = err
@@ -275,6 +279,9 @@ class ServingFrontend:
         return meta
 
     def healthz(self) -> dict:
+        # Queue depth, limit, and windowed batch occupancy ride along so
+        # the fleet router's occupancy-aware dispatch has ONE cheap scrape
+        # endpoint instead of parsing the full /metrics exposition.
         return {
             "status": "draining" if self.draining else "ok",
             "version": self.engine.version,
@@ -282,6 +289,8 @@ class ServingFrontend:
             "tile": list(self.engine.tile),
             "channels": self.engine.channels,
             "queue_depth": self.batcher.queue_depth,
+            "queue_limit": self.cfg.queue_limit,
+            "batch_occupancy": self.metrics.occupancy(),
             "compiled_shapes": self.engine.compiled_shapes,
             "last_reload_error": self.last_reload_error,
             "alerts": list(self.health.alerts),
@@ -366,6 +375,51 @@ def _dump_npy(arr: np.ndarray) -> bytes:
     return buf.getvalue()
 
 
+class ServeHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that counts in-flight requests.
+
+    Idle keep-alive connections hold no count — only a request actually
+    being handled does — so the graceful SIGTERM drain can wait for real
+    work without being wedged by a client that simply left its connection
+    open.  Handler threads stay daemonic; the drain waits on THIS counter,
+    not thread joins."""
+
+    daemon_threads = True
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._inflight = 0
+        self._inflight_cond = threading.Condition()
+
+    def request_began(self) -> None:
+        with self._inflight_cond:
+            self._inflight += 1
+
+    def request_finished(self) -> None:
+        with self._inflight_cond:
+            self._inflight -= 1
+            self._inflight_cond.notify_all()
+
+    @property
+    def inflight(self) -> int:
+        with self._inflight_cond:
+            return self._inflight
+
+    def wait_idle(self, timeout: Optional[float] = None) -> bool:
+        """Block until no request is being handled (True) or ``timeout``
+        expires with work still in flight (False)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._inflight_cond:
+            while self._inflight > 0:
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._inflight_cond.wait(remaining)
+            return True
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "ddlpc-serve/1"
     protocol_version = "HTTP/1.1"
@@ -376,6 +430,32 @@ class _Handler(BaseHTTPRequestHandler):
 
     def log_message(self, fmt, *args):  # quiet by default; metrics cover it
         pass
+
+    def do_GET(self) -> None:
+        # In-flight accounting wraps the dispatch (handler → response
+        # write), NOT the connection: an idle keep-alive socket blocked in
+        # readline() between requests holds no count, so the graceful
+        # drain waits for real work only.
+        began = getattr(self.server, "request_began", None)
+        if began is None:
+            self._dispatch_get()
+            return
+        began()
+        try:
+            self._dispatch_get()
+        finally:
+            self.server.request_finished()
+
+    def do_POST(self) -> None:
+        began = getattr(self.server, "request_began", None)
+        if began is None:
+            self._dispatch_post()
+            return
+        began()
+        try:
+            self._dispatch_post()
+        finally:
+            self.server.request_finished()
 
     def _send_json(self, code: int, obj: dict, extra=()) -> None:
         body = json.dumps(obj).encode()
@@ -395,7 +475,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def do_GET(self) -> None:
+    def _dispatch_get(self) -> None:
         parsed = urlparse(self.path)
         path = parsed.path
         if path == "/healthz":
@@ -439,7 +519,7 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send_json(404, {"error": f"no route {path}"})
 
-    def do_POST(self) -> None:
+    def _dispatch_post(self) -> None:
         parsed = urlparse(self.path)
         try:
             length = int(self.headers.get("Content-Length", 0))
@@ -494,7 +574,7 @@ class _Handler(BaseHTTPRequestHandler):
         # the last resort for its SUCCESS path (metrics log, alert emit —
         # e.g. ENOSPC mid-write): a JSON 500 beats a dropped socket.
         try:
-            meta = self.frontend.reload(req.get("workdir"))
+            meta = self.frontend.reload(req.get("workdir"), step=req.get("step"))
         except Exception as e:
             self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
             return
@@ -519,12 +599,33 @@ class _Handler(BaseHTTPRequestHandler):
 
 def make_server(
     frontend: ServingFrontend, host: str = "127.0.0.1", port: int = 0
-) -> ThreadingHTTPServer:
+) -> ServeHTTPServer:
     """Bind a threading HTTP server over ``frontend`` (port 0 = ephemeral)."""
-    server = ThreadingHTTPServer((host, port), _Handler)
-    server.daemon_threads = True
+    server = ServeHTTPServer((host, port), _Handler)
     server.frontend = frontend  # type: ignore[attr-defined]
     return server
+
+
+def drain_and_close(
+    server: ServeHTTPServer,
+    frontend: ServingFrontend,
+    timeout_s: float = 30.0,
+) -> bool:
+    """Graceful shutdown after the accept loop has stopped (ISSUE 10
+    satellite): mark draining (``/healthz`` flips to 503 for anything that
+    still scrapes), let in-flight HTTP requests finish writing their
+    responses, drain the batcher's queued work, flush the final metrics
+    snapshot, release the socket.  Returns False if ``timeout_s`` expired
+    with requests still in flight (the process exits anyway — a wedged
+    client must not hold shutdown hostage)."""
+    frontend.draining = True
+    clean = server.wait_idle(timeout=timeout_s)
+    # Everything admitted before the accept loop stopped is now either
+    # answered or queued in the batcher; close(drain=True) finishes the
+    # queue and flushes the final snapshot to serve_metrics.jsonl.
+    frontend.close(drain=True)
+    server.server_close()
+    return clean
 
 
 def main(argv=None) -> int:
@@ -533,6 +634,11 @@ def main(argv=None) -> int:
     p.add_argument("--workdir", help="training run to serve (overrides config)")
     p.add_argument("--host")
     p.add_argument("--port", type=int)
+    p.add_argument(
+        "--port-file",
+        help="write the bound port here once ready (how the fleet "
+        "supervisor learns an ephemeral --port 0 assignment)",
+    )
     args = p.parse_args(argv)
 
     cfg = ServeConfig()
@@ -552,12 +658,23 @@ def main(argv=None) -> int:
 
     engine = InferenceEngine.from_workdir(cfg.workdir, max_bucket=cfg.max_batch)
     engine.warmup()  # compile every bucket before declaring ready
-    logger = MetricsLogger(cfg.workdir, basename="serve_metrics")
+    metrics_dir = cfg.metrics_dir or cfg.workdir
+    os.makedirs(metrics_dir, exist_ok=True)
+    logger = MetricsLogger(metrics_dir, basename="serve_metrics")
     frontend = ServingFrontend(engine, cfg, logger=logger)
     server = make_server(frontend, cfg.host, cfg.port)
+    if args.port_file:
+        # Written AFTER warmup + bind: first contact never pays a compile,
+        # and the file's very existence means "this port answers".
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(server.server_address[1]))
+        os.replace(tmp, args.port_file)
 
     def _shutdown(signum, frame):
-        # Graceful drain: stop accepting, finish queued work, then exit.
+        # Stop accepting; the post-loop drain below finishes in-flight
+        # work, flushes metrics, and exits 0 — never a dropped request.
+        frontend.draining = True
         threading.Thread(target=server.shutdown, daemon=True).start()
 
     signal.signal(signal.SIGTERM, _shutdown)
@@ -570,8 +687,7 @@ def main(argv=None) -> int:
     try:
         server.serve_forever()
     finally:
-        frontend.close(drain=True)
-        server.server_close()
+        drain_and_close(server, frontend, timeout_s=cfg.drain_timeout_s)
     return 0
 
 

@@ -3,7 +3,8 @@
 Every JSONL stream a run emits — metrics.jsonl (training records plus the
 interleaved alert and kind="perf"/"comm" accounting records),
 serve_metrics.jsonl, spans.jsonl, serve_spans.jsonl, resilience.jsonl
-(the supervisor's attempt/give-up stream) — must be one FLAT JSON object
+(the supervisor's attempt/give-up stream), router.jsonl (the fleet
+router/supervisor stream) — must be one FLAT JSON object
 per line (scalars or lists of scalars) carrying an integer ``schema``
 field and a ``kind`` registered in obs/schema.py:KNOWN_KINDS.  That
 contract is what lets scripts/obs_tail.py tail any stream unchanged and

@@ -15,6 +15,10 @@ makes the cheap arms REPLAYABLE and COMPARABLE: it re-measures
   path on a synthetic dataset;
 - ``serve_p99_ms``     — the closed-loop serving load
   (scripts/serve_bench.py) against a tiny synthetic checkpoint;
+- ``fleet_p99_ms``     — the routed FLEET path: the same load dispatched
+  by the router over 2 engine-replica subprocesses
+  (scripts/serve_bench.py --fleet), so retries/hedging/breaker machinery
+  is inside the measured path;
 
 and fails loudly (exit 1, naming the metric) when any gated metric
 regresses past its tolerance band versus the committed
@@ -66,6 +70,10 @@ GATED = {
         unit="tiles/s", direction="higher", tolerance=0.50
     ),
     "serve_p99_ms": dict(unit="ms", direction="lower", tolerance=0.60),
+    # Fleet path: router dispatch over 2 engine-replica subprocesses
+    # (scripts/serve_bench.py --fleet).  Carries subprocess + HTTP + CPU
+    # scheduling noise on top of the engine, hence the widest band.
+    "fleet_p99_ms": dict(unit="ms", direction="lower", tolerance=0.75),
 }
 
 
@@ -341,6 +349,24 @@ def arm_serve(rounds: int) -> Dict[str, float]:
     return {"serve_p99_ms": float(rec["value"])}
 
 
+def arm_fleet(rounds: int) -> Dict[str, float]:
+    """fleet_p99_ms: routed load over 2 replica subprocesses (the fleet
+    path from ISSUE 10 — retries/hedging/breaker machinery included in
+    what is measured, exactly like production)."""
+    import tempfile
+
+    import serve_bench
+
+    with tempfile.TemporaryDirectory() as tmp:
+        workdir = os.path.join(tmp, "gate_fleet_run")
+        serve_bench.make_tiny_run(workdir)
+        rec = serve_bench.run_fleet_load(
+            workdir, replicas=2, clients=2, requests=24, tile=32,
+            max_batch=4, max_wait_ms=2.0,
+        )
+    return {"fleet_p99_ms": float(rec["value"])}
+
+
 def measure(args) -> Dict[str, float]:
     measured: Dict[str, float] = {}
     if not args.skip_step:
@@ -349,6 +375,8 @@ def measure(args) -> Dict[str, float]:
         measured.update(arm_loader(args.rounds))
     if not args.skip_serve:
         measured.update(arm_serve(args.rounds))
+    if not args.skip_fleet:
+        measured.update(arm_fleet(args.rounds))
     return measured
 
 
@@ -391,6 +419,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--skip-step", action="store_true")
     ap.add_argument("--skip-loader", action="store_true")
     ap.add_argument("--skip-serve", action="store_true")
+    ap.add_argument("--skip-fleet", action="store_true")
     ap.add_argument("--inject", action="append", default=[],
                     metavar="METRIC=FACTOR",
                     help="multiply a measured value before comparing "

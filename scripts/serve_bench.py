@@ -168,6 +168,119 @@ def run_load(
     }
 
 
+def run_fleet_load(
+    workdir: str,
+    replicas: int,
+    clients: int,
+    requests: int,
+    tile: int,
+    max_batch: int,
+    max_wait_ms: float,
+    warmup_timeout_s: float = 300.0,
+) -> dict:
+    """``--fleet N`` arm: closed-loop load through the FLEET path — router
+    dispatch over N real engine-replica subprocesses on this host (each a
+    ``python -m ddlpc_tpu.serve.server`` on an ephemeral port).  Latency
+    comes from the ROUTER metrics stream, so retries/hedges/breaker
+    behavior is part of what is measured, exactly like production.
+
+    Driver contract: the caller prints ONE JSON line with
+    ``{"metric": "fleet_p99_ms", ...}``.
+    """
+    import io
+
+    import numpy as np
+
+    from ddlpc_tpu.config import FleetConfig
+    from ddlpc_tpu.serve.fleet import ReplicaSupervisor
+    from ddlpc_tpu.serve.router import FleetRouter
+
+    cfg = FleetConfig(
+        workdir=workdir,
+        replicas=replicas,
+        max_batch=max_batch,
+        max_wait_ms=max_wait_ms,
+        queue_limit=max(4 * max_batch * clients, 64),
+        deadline_ms=0.0,  # closed loop saturates; deadlines would just shed
+        hedge_ms=0.0,  # a saturating bench measures capacity, not tail
+        scrape_every_s=0.5,
+        warmup_timeout_s=warmup_timeout_s,
+    )
+
+    def env_fn(idx: int, launch: int):
+        env = dict(os.environ)
+        env.pop("DDLPC_CHAOS", None)  # the bench is chaos-free
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        return env
+
+    router = FleetRouter(cfg)
+    sup = ReplicaSupervisor(cfg, router=router, env_fn=env_fn, echo=False)
+    t_start = time.perf_counter()
+    ready = sup.start(wait_ready=True)
+    startup_s = time.perf_counter() - t_start
+    if ready < replicas:
+        sup.stop()
+        raise RuntimeError(f"only {ready}/{replicas} replicas became ready")
+
+    rng = np.random.default_rng(0)
+    buf = io.BytesIO()
+    np.save(
+        buf,
+        rng.uniform(0, 1, (tile, tile, 3)).astype(np.float32),
+        allow_pickle=False,
+    )
+    body = buf.getvalue()
+
+    # Warm the routed path once per replica, then reset the rate interval.
+    for _ in range(replicas):
+        router.dispatch(body)
+    router.metrics.snapshot()
+
+    per_client = max(requests // clients, 1)
+    errors = []
+
+    def client(i: int) -> None:
+        for _ in range(per_client):
+            status, _, _ = router.dispatch(body)
+            if status >= 500:
+                errors.append(status)
+
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t0
+    snap = router.metrics.snapshot()
+    sup.stop()
+
+    p99 = snap["p99_ms"]
+    return {
+        "metric": "fleet_p99_ms",
+        "value": p99,
+        "unit": "ms",
+        "vs_baseline": (
+            round(BASELINE_P99_MS / p99, 3) if p99 else None
+        ),
+        "p50_ms": snap["p50_ms"],
+        "p95_ms": snap["p95_ms"],
+        "requests_per_sec": round((per_client * clients) / wall_s, 3),
+        "requests": snap["requests"],
+        "errors_5xx": snap["errors_5xx"],
+        "retries": snap["retries"],
+        "hedges": snap["hedges"],
+        "bench_errors": len(errors),
+        "replicas": replicas,
+        "clients": clients,
+        "startup_s": round(startup_s, 1),
+        "wall_s": round(wall_s, 3),
+        "max_batch": max_batch,
+    }
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument(
@@ -185,21 +298,35 @@ def main() -> int:
     )
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--max-wait-ms", type=float, default=2.0)
+    p.add_argument(
+        "--fleet", type=int, default=0, metavar="N",
+        help="measure the FLEET path instead: N engine-replica "
+        "subprocesses behind the router (driver-contract fleet_p99_ms)",
+    )
+    p.add_argument(
+        "--tile", type=int, default=32,
+        help="(--fleet) request tile edge — fleet requests are one tile",
+    )
     args = p.parse_args()
 
-    if args.workdir:
-        result = run_load(
-            args.workdir, args.clients, args.requests, args.scene,
+    def run(workdir: str) -> dict:
+        if args.fleet > 0:
+            return run_fleet_load(
+                workdir, args.fleet, args.clients, args.requests,
+                args.tile, args.max_batch, args.max_wait_ms,
+            )
+        return run_load(
+            workdir, args.clients, args.requests, args.scene,
             args.max_batch, args.max_wait_ms,
         )
+
+    if args.workdir:
+        result = run(args.workdir)
     else:
         with tempfile.TemporaryDirectory() as tmp:
             workdir = os.path.join(tmp, "serve_bench_run")
-            make_tiny_run(workdir)
-            result = run_load(
-                workdir, args.clients, args.requests, args.scene,
-                args.max_batch, args.max_wait_ms,
-            )
+            make_tiny_run(workdir, tile=args.tile if args.fleet else 32)
+            result = run(workdir)
     print(json.dumps(result))
     return 0
 

@@ -21,15 +21,16 @@ import jax
 from ddlpc_tpu.config import ExperimentConfig
 
 CONFIG_DIR = os.path.join(os.path.dirname(__file__), "..", "configs")
-# serve_*.json are ServeConfig deploy artifacts (PR 1), not experiments:
-# parsing one as an ExperimentConfig silently yields ALL-DEFAULTS (every
-# section missing), which both wasted a full default-config training run
-# here and failed the semantics assertions on fields the artifact never
-# had.  test_trainer.py::test_configs_dir_parses covers their round-trip.
+# serve_*.json / fleet_*.json are ServeConfig/FleetConfig deploy artifacts
+# (PR 1 / ISSUE 10), not experiments: parsing one as an ExperimentConfig
+# silently yields ALL-DEFAULTS (every section missing), which both wasted
+# a full default-config training run here and failed the semantics
+# assertions on fields the artifact never had.
+# test_trainer.py::test_configs_dir_parses covers their round-trip.
 CONFIG_FILES = sorted(
     p
     for p in glob.glob(os.path.join(CONFIG_DIR, "*.json"))
-    if not os.path.basename(p).startswith("serve_")
+    if not os.path.basename(p).startswith(("serve_", "fleet_"))
 )
 
 # Tier-1 budget (ROADMAP: 870 s for the whole suite): one representative

@@ -1,0 +1,339 @@
+"""Fleet supervision pieces (ISSUE 10): serve-side chaos faults, the
+shared RestartPolicy, FleetConfig plumbing, the single-process server's
+graceful SIGTERM drain, and (slow) the full fleet soak.
+
+Fast tests use fake engines and fake checkpoint files — no subprocesses,
+no compiles.  The real 3-replica fleet under the fault storm runs in the
+slow-marked soak test."""
+
+import io
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ddlpc_tpu.config import FleetConfig, ServeConfig
+from ddlpc_tpu.resilience.chaos import ChaosError, ChaosFault, ChaosMonkey
+from ddlpc_tpu.resilience.supervisor import RestartPolicy
+
+TILE = (16, 16)
+NCLASS = 3
+
+
+# ---- serve-side chaos faults ------------------------------------------------
+
+
+def test_chaos_parses_serve_faults():
+    m = ChaosMonkey("serve_kill@5;serve_stall@3:2;serve_err@7:4;reload_corrupt@2")
+    assert m.serve_faults[5] == [{"kind": "serve_kill", "dur": None}]
+    assert m.serve_faults[3] == [{"kind": "serve_stall", "dur": 2.0}]
+    assert m.serve_faults[7] == [{"kind": "serve_err", "dur": 4.0}]
+    assert m.reload_corrupt_at == 2
+
+
+def test_chaos_serve_err_burst_covers_k_forwards():
+    m = ChaosMonkey("serve_err@3:2")
+    m.on_serve_forward()  # 1
+    m.on_serve_forward()  # 2
+    with pytest.raises(ChaosFault):
+        m.on_serve_forward()  # 3: burst starts
+    with pytest.raises(ChaosFault):
+        m.on_serve_forward()  # 4: burst continues (K=2)
+    m.on_serve_forward()  # 5: burst over
+    assert [f["kind"] for f in m.fired] == ["serve_err"]
+
+
+def test_chaos_serve_stall_sleeps(monkeypatch):
+    slept = []
+    import ddlpc_tpu.resilience.chaos as chaos_mod
+
+    monkeypatch.setattr(chaos_mod.time, "sleep", slept.append)
+    m = ChaosMonkey("serve_stall@1:7")
+    m.on_serve_forward()
+    assert slept == [7.0]
+    m.on_serve_forward()  # one-shot: fires once per process
+    assert slept == [7.0]
+
+
+def test_chaos_reload_corrupt_flips_newest_blob(tmp_path):
+    ckdir = tmp_path / "checkpoints"
+    ckdir.mkdir()
+    (ckdir / "ckpt_1.dwc").write_bytes(b"A" * 100)
+    (ckdir / "ckpt_3.dwc").write_bytes(b"B" * 100)
+    m = ChaosMonkey("reload_corrupt@2")
+    m.on_serve_reload(str(ckdir))  # reload 1: nothing
+    assert (ckdir / "ckpt_3.dwc").read_bytes() == b"B" * 100
+    m.on_serve_reload(str(ckdir))  # reload 2: flips a byte of the NEWEST
+    data = (ckdir / "ckpt_3.dwc").read_bytes()
+    assert data != b"B" * 100
+    assert sum(a != b for a, b in zip(data, b"B" * 100)) == 1
+    assert (ckdir / "ckpt_1.dwc").read_bytes() == b"A" * 100  # untouched
+    m.on_serve_reload(str(ckdir))  # one-shot
+    assert (ckdir / "ckpt_3.dwc").read_bytes() == data
+
+
+def test_chaos_unknown_serve_fault_is_loud():
+    with pytest.raises(ChaosError):
+        ChaosMonkey("serve_explode@3")
+
+
+# ---- RestartPolicy (shared supervisor machinery) ----------------------------
+
+
+def test_restart_policy_crash_loop_and_progress_reset():
+    p = RestartPolicy(crash_loop_limit=3, backoff_base_s=1.0)
+    assert p.record_exit(progressed=False) == "restart"
+    assert p.record_exit(progressed=False) == "restart"
+    assert p.record_exit(progressed=True) == "restart"  # streak resets
+    assert p.fail_streak == 0
+    assert p.record_exit(progressed=False) == "restart"
+    assert p.record_exit(progressed=False) == "restart"
+    assert p.record_exit(progressed=False) == "give_up_crash_loop"
+
+
+def test_restart_policy_budget():
+    p = RestartPolicy(max_restarts=2, crash_loop_limit=99)
+    assert p.record_exit(progressed=True) == "restart"
+    assert p.record_exit(progressed=True) == "restart"
+    assert p.record_exit(progressed=True) == "give_up_budget"
+
+
+def test_restart_policy_backoff_is_full_jitter():
+    class Ceiling:
+        def uniform(self, lo, hi):
+            return hi
+
+    p = RestartPolicy(backoff_base_s=2.0, backoff_cap_s=9.0, rng=Ceiling())
+    assert p.backoff_s(0) == 0.0
+    assert p.backoff_s(1) == 2.0
+    assert p.backoff_s(2) == 4.0
+    assert p.backoff_s(3) == 8.0
+    assert p.backoff_s(4) == 9.0  # capped
+
+
+# ---- FleetConfig ------------------------------------------------------------
+
+
+def test_fleet_config_roundtrip_and_unknown_key():
+    cfg = FleetConfig(replicas=5, hedge_ms=0.0)
+    back = FleetConfig.from_json(cfg.to_json())
+    assert back == cfg
+    with pytest.raises(ValueError, match="unknown config key"):
+        FleetConfig.from_dict({"replicaz": 3})
+
+
+def test_fleet_replica_serve_config_forwards_knobs(tmp_path):
+    cfg = FleetConfig(
+        workdir="runs/x", max_batch=4, queue_limit=32, deadline_ms=500.0
+    )
+    sc = cfg.replica_serve_config(metrics_dir=str(tmp_path))
+    assert isinstance(sc, ServeConfig)
+    assert sc.workdir == "runs/x"
+    assert sc.port == 0  # ephemeral: the supervisor reads the port file
+    assert (sc.max_batch, sc.queue_limit, sc.deadline_ms) == (4, 32, 500.0)
+    assert sc.metrics_dir == str(tmp_path)
+
+
+def test_fleet_vaihingen_config_parses():
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "configs", "fleet_vaihingen.json"
+    )
+    cfg = FleetConfig.from_json(open(path).read())
+    assert cfg.replicas == 3
+
+
+# ---- graceful drain of the single-process server (satellite) ---------------
+
+
+class FakeEngine:
+    """Minimal engine for frontend/server tests: no jax, no checkpoint."""
+
+    def __init__(self, forward_delay_s: float = 0.0):
+        self.tile = TILE
+        self.channels = 3
+        self.version = 0
+        self.checkpoint_step = 1
+        self.compiled_shapes = 1
+        self.forward_delay_s = forward_delay_s
+        self.reload_calls = []
+
+    def forward_windows(self, windows):
+        if self.forward_delay_s:
+            time.sleep(self.forward_delay_s)
+        w = np.asarray(windows, np.float32)
+        return np.zeros((len(w), *TILE, NCLASS), np.float32)
+
+    def reload(self, workdir=None, step=None):
+        self.reload_calls.append({"workdir": workdir, "step": step})
+        self.version += 1
+        if step is not None:
+            self.checkpoint_step = int(step)
+        return {"step": self.checkpoint_step}
+
+
+def _start_server(engine, logger=None, **cfg_kw):
+    from ddlpc_tpu.serve.server import ServingFrontend, make_server
+
+    cfg_kw.setdefault("metrics_every_s", 0)
+    cfg = ServeConfig(**cfg_kw)
+    frontend = ServingFrontend(engine, cfg, logger=logger)
+    server = make_server(frontend)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    port = server.server_address[1]
+    return server, frontend, port, t
+
+
+def _npy_body(shape=(*TILE, 3)):
+    buf = io.BytesIO()
+    np.save(buf, np.zeros(shape, np.float32), allow_pickle=False)
+    return buf.getvalue()
+
+
+def test_healthz_carries_occupancy_and_queue_limit():
+    """Satellite: the router's occupancy-aware dispatch scrapes ONE cheap
+    endpoint — /healthz must carry queue depth, limit, AND occupancy."""
+    server, frontend, port, t = _start_server(FakeEngine(), queue_limit=32)
+    try:
+        frontend.predict_classes(np.zeros((*TILE, 3), np.float32))
+        h = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5
+            ).read()
+        )
+        assert h["queue_limit"] == 32
+        assert h["queue_depth"] == 0
+        assert 0.0 < h["batch_occupancy"] <= 1.0
+    finally:
+        server.shutdown()
+        frontend.close()
+        server.server_close()
+
+
+def test_reload_accepts_explicit_step():
+    """Satellite: the fleet rollback pins every replica to the OLD step
+    with an explicit /reload {"step": N}."""
+    eng = FakeEngine()
+    server, frontend, port, t = _start_server(eng)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/reload",
+            data=json.dumps({"step": 7}).encode(),
+            method="POST",
+        )
+        resp = json.loads(urllib.request.urlopen(req, timeout=5).read())
+        assert resp["step"] == 7
+        assert eng.reload_calls == [{"workdir": None, "step": 7}]
+    finally:
+        server.shutdown()
+        frontend.close()
+        server.server_close()
+
+
+def test_graceful_drain_completes_inflight_request_and_flushes_metrics(
+    tmp_path,
+):
+    """Satellite: SIGTERM-equivalent shutdown finishes the in-flight HTTP
+    request (response fully written), drains the batcher, flushes the
+    final metrics snapshot, and reports a clean drain."""
+    from ddlpc_tpu.serve.server import drain_and_close
+    from ddlpc_tpu.train.observability import MetricsLogger
+
+    logger = MetricsLogger(str(tmp_path), basename="serve_metrics")
+    eng = FakeEngine(forward_delay_s=0.4)
+    server, frontend, port, t = _start_server(eng, logger=logger)
+    results = []
+
+    def client():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict", data=_npy_body(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            results.append((resp.status, resp.read()))
+
+    ct = threading.Thread(target=client, daemon=True)
+    ct.start()
+    # Wait until the request is actually in flight, then shut down.
+    deadline = time.monotonic() + 5
+    while server.inflight == 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert server.inflight == 1
+    server.shutdown()  # what the SIGTERM handler does
+    clean = drain_and_close(server, frontend, timeout_s=30.0)
+    ct.join(timeout=10)
+    assert clean is True
+    assert len(results) == 1
+    status, body = results[0]
+    assert status == 200
+    pred = np.load(io.BytesIO(body))
+    assert pred.shape == TILE  # the in-flight prediction was fully served
+    # The final snapshot reached serve_metrics.jsonl on the way out.
+    records = [
+        json.loads(l)
+        for l in (tmp_path / "serve_metrics.jsonl").read_text().splitlines()
+    ]
+    assert any(r.get("kind") == "serve" and r.get("requests") == 1
+               for r in records)
+    # And the frontend reported draining before the drain completed.
+    assert frontend.draining
+
+
+def test_drain_times_out_rather_than_hang(tmp_path):
+    from ddlpc_tpu.serve.server import drain_and_close
+
+    eng = FakeEngine(forward_delay_s=3.0)
+    server, frontend, port, t = _start_server(eng)
+    ct = threading.Thread(
+        target=lambda: urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict", data=_npy_body(),
+                method="POST",
+            ),
+            timeout=30,
+        ).read(),
+        daemon=True,
+    )
+    ct.start()
+    deadline = time.monotonic() + 5
+    while server.inflight == 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    server.shutdown()
+    t0 = time.monotonic()
+    clean = drain_and_close(server, frontend, timeout_s=0.1)
+    # The HTTP-level wait gave up at 0.1s (clean=False); the batcher then
+    # finishes its one in-flight forward (~3s) — bounded, never a hang.
+    assert clean is False
+    assert time.monotonic() - t0 < 15.0
+    ct.join(timeout=10)
+
+
+# ---- the full fleet under the fault storm (slow) ---------------------------
+
+
+@pytest.mark.slow
+def test_fleet_soak_quick_survives(tmp_path):
+    """The acceptance scenario end-to-end: a 3-replica fleet (real
+    subprocesses) under kill + stall + error burst + corrupt-reload with
+    sustained client load — zero client-visible 5xx, >= 2 rolling
+    reloads, fleet-wide rollback on the quarantined blob."""
+    import scripts.fleet_soak as fleet_soak
+
+    class Args:
+        workdir = str(tmp_path / "soak")
+        clients = 4
+        quick = True
+        quiet = True
+        warmup_timeout_s = 300.0
+        phase_timeout_s = 180.0
+
+    report = fleet_soak.run_soak(Args())
+    assert report["load"]["errors_5xx_count"] == 0
+    assert report["completed_rolling_reloads"] >= 2
+    assert report["events"]["reload_2_aborted"]["ok"] is False
+    assert report["events"]["reload_2_aborted"]["rollback_clean"] is True
+    assert report["survived"] is True

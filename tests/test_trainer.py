@@ -250,15 +250,19 @@ def test_configs_dir_parses():
     config system."""
     import glob
 
-    from ddlpc_tpu.config import ExperimentConfig, ServeConfig
+    from ddlpc_tpu.config import ExperimentConfig, FleetConfig, ServeConfig
 
     paths = sorted(glob.glob(os.path.join(os.path.dirname(__file__), "..", "configs", "*.json")))
-    # 5 BASELINE parity + TPU flagship + s2d U-Net++ + serving deploy
-    assert len(paths) == 8
+    # 5 BASELINE parity + TPU flagship + s2d U-Net++ + serve + fleet deploys
+    assert len(paths) == 9
     for p in paths:
         if os.path.basename(p).startswith("serve_"):
             # serve_*.json are ServeConfig deploy artifacts, not experiments
             ServeConfig.from_json(open(p).read())
+            continue
+        if os.path.basename(p).startswith("fleet_"):
+            # fleet_*.json are FleetConfig deploy artifacts (ISSUE 10)
+            FleetConfig.from_json(open(p).read())
             continue
         cfg = ExperimentConfig.from_json(open(p).read())
         assert cfg.model.num_classes == cfg.data.num_classes
