@@ -1,0 +1,26 @@
+# Repo-level entry points (docs/ANALYSIS.md).
+#
+#   make check     — the project invariant analyzer (scripts/ddlpc_check.py:
+#                    import tiers, AST rules, lock-order smoke) + the native
+#                    kernel toolchain check (csrc self-test)
+#   make sanitize  — rebuild + run the csrc self-test & threaded stress
+#                    under ASan/UBSan (TSan where supported)
+#   make test      — the tier-1 suite (what CI runs; see ROADMAP.md)
+
+PYTHON ?= python
+
+check: ddlpc-check csrc-check
+
+ddlpc-check:
+	$(PYTHON) scripts/ddlpc_check.py
+
+csrc-check:
+	$(MAKE) -C csrc check
+
+sanitize:
+	$(MAKE) -C csrc sanitize
+
+test:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'
+
+.PHONY: check ddlpc-check csrc-check sanitize test

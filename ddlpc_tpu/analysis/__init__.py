@@ -1,0 +1,25 @@
+"""Project invariant analyzer (``scripts/ddlpc_check.py``, docs/ANALYSIS.md).
+
+Four arms, one command:
+
+- :mod:`tiers` — import-graph checker: every ``ddlpc_tpu`` module declares
+  an import-time tier (``stdlib`` / ``host`` / ``jax``) in one registry,
+  and the checker transitively proves the declaration — the supervisor and
+  routing tiers stay jax-free so a fleet restart never pays an XLA import;
+- :mod:`rules` — AST rules over ``ddlpc_tpu/`` + ``scripts/`` (one class
+  per rule, shared visitor, ``# ddlpc-check: disable=RULE reason``
+  suppressions): schema-stamped JSONL emits, metric-name/docs drift,
+  tmp+fsync+rename report writes, host calls inside jitted functions,
+  fenced codec invocations in ``parallel/``;
+- :mod:`lockcheck` — instrumented ``Lock``/``RLock``/``Condition``
+  recording the cross-thread lock-acquisition graph (cycle = lock-order
+  inversion) and enforcing ``# guarded-by:`` attribute annotations at
+  runtime; near-zero cost when disabled;
+- sanitizer wiring lives in ``csrc/Makefile`` (``make -C csrc sanitize``)
+  with a build-or-skip canary in ``tests/test_analysis.py``.
+
+This package (minus :mod:`lock_fixtures`, which imports the serve tier to
+exercise it) is pure stdlib, so the analyzer itself runs without jax.
+"""
+
+from __future__ import annotations

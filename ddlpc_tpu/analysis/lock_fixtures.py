@@ -1,0 +1,182 @@
+"""Bounded runtime exercises for the lock-order detector.
+
+``run_smoke`` drives the repo's instrumented concurrency hot spots — the
+MicroBatcher, StageTimer, Tracer, HealthMonitor, CircuitBreaker and (when
+jax is importable) the loader's ``_HostRing`` — under real thread
+contention for a fraction of a second, then returns the recorded
+acquisition graph and guard violations.  ``scripts/ddlpc_check.py`` runs
+it on every invocation and fails on any cycle or guarded-by violation;
+the same classes also run instrumented whenever the tier-1 threaded tests
+execute with ``DDLPC_LOCKCHECK=1``.
+
+``inversion_demo`` is the committed NEGATIVE fixture: two locks taken in
+opposite orders on two threads — the analyzer must fail on it
+(``tests/test_analysis.py`` pins that it does).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ddlpc_tpu.analysis import lockcheck
+
+
+def _threads(n: int, fn) -> None:
+    ts = [threading.Thread(target=fn, args=(i,)) for i in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+
+def run_smoke(workdir: Optional[str] = None) -> dict:
+    """Exercise the instrumented classes; returns ``lockcheck.report()``.
+
+    Must be called with lockcheck enabled (the CLI does).  Each arm is a
+    few hundred operations — enough to cross every lock pair the classes
+    can produce, cheap enough to run on every ``ddlpc_check``.
+    """
+    import os
+    import tempfile
+
+    from ddlpc_tpu.obs.health import Alert, HealthMonitor
+    from ddlpc_tpu.obs.tracing import Tracer
+    from ddlpc_tpu.serve.batching import MicroBatcher
+    from ddlpc_tpu.serve.router import CircuitBreaker
+
+    report: dict = {"arms": []}
+
+    # MicroBatcher: concurrent submit/shed/drain against a live worker.
+    mb = MicroBatcher(
+        forward=lambda xs: [x * 2 for x in xs],
+        max_batch=4,
+        max_wait_ms=1.0,
+        queue_limit=64,
+    )
+
+    def submit(i: int) -> None:
+        for k in range(20):
+            try:
+                mb.submit(k).result(timeout=5)
+            except Exception:
+                pass
+            mb.queue_depth  # noqa: B018  — cross-thread read path
+
+    _threads(4, submit)
+    mb.close(drain=True)
+    report["arms"].append("MicroBatcher")
+
+    # Tracer: spans from several threads + cross-thread add_span + flush.
+    with tempfile.TemporaryDirectory(dir=workdir) as td:
+        tr = Tracer(
+            enabled=True,
+            jsonl_path=os.path.join(td, "spans.jsonl"),
+            chrome_path=os.path.join(td, "trace.json"),
+        )
+
+        def trace(i: int) -> None:
+            for k in range(15):
+                with tr.span(f"phase{i}", k=k):
+                    pass
+                tr.add_span("xthread", tr.now(), tr.now())
+
+        _threads(4, trace)
+        tr.flush()
+        tr.close()
+    report["arms"].append("Tracer")
+
+    # HealthMonitor: emit storm vs /healthz-style snapshot reads.
+    hm = HealthMonitor()
+
+    def health(i: int) -> None:
+        for k in range(20):
+            hm.emit(
+                Alert(
+                    alert="step_time_regression",
+                    severity="warn",
+                    message="lockcheck smoke",
+                    value=float(k),
+                    threshold=1.0,
+                )
+            )
+            hm.alerts
+
+    _threads(3, health)
+    report["arms"].append("HealthMonitor")
+
+    # CircuitBreaker: outcome storm across the latch transitions.
+    br = CircuitBreaker(window=8, min_samples=4, cooldown_s=0.0)
+
+    def breaker(i: int) -> None:
+        for k in range(30):
+            if br.acquire():
+                br.record(k % 3 != 0)
+            br.available()
+            if k % 7 == 0:
+                br.release()
+
+    _threads(4, breaker)
+    report["arms"].append("CircuitBreaker")
+
+    # StageTimer and _HostRing live in jax-tier modules — exercise them
+    # when the import works, note the skip when it doesn't (the analyzer
+    # itself must run on a stdlib-only install).
+    try:
+        from ddlpc_tpu.data.loader import _HostRing, _Slot
+        from ddlpc_tpu.train.observability import StageTimer
+    except Exception as e:  # pragma: no cover - jax-less environment
+        report["jax_arms_skipped"] = f"{type(e).__name__}: {e}"
+    else:
+        st = StageTimer()
+
+        def stages(i: int) -> None:
+            for _ in range(25):
+                with st.stage(f"s{i % 3}"):
+                    pass
+                st.summary()
+                st.means()
+
+        _threads(4, stages)
+        st.reset()
+        report["arms"].append("StageTimer")
+
+        ring = _HostRing(2, lambda reuse_scratch_from=None: _Slot(0, 0))
+
+        def churn(i: int) -> None:
+            for k in range(25):
+                slot = ring.acquire()
+                ring.release(slot, retire=(k % 5 == 0))
+
+        _threads(4, churn)
+        report["arms"].append("_HostRing")
+
+    report.update(lockcheck.report())
+    return report
+
+
+def inversion_demo() -> dict:
+    """Deliberate lock-order inversion: A→B on one thread, B→A on another
+    (sequenced so the demo itself cannot deadlock).  The analyzer must
+    report a cycle."""
+    a = lockcheck.lock("demo.A")
+    b = lockcheck.lock("demo.B")
+    done_ab = threading.Event()
+
+    def t_ab() -> None:
+        with a:
+            with b:
+                pass
+        done_ab.set()
+
+    def t_ba() -> None:
+        done_ab.wait(5)
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=t_ab)
+    t2 = threading.Thread(target=t_ba)
+    t1.start(), t2.start()
+    t1.join(), t2.join()
+    return lockcheck.report()

@@ -1,0 +1,504 @@
+"""The project AST rules (catalog + rationale: docs/ANALYSIS.md).
+
+Each rule is one class; all of them run off one shared AST walk
+(:mod:`core`).  Rules are deliberately *syntactic* — they prove the
+idioms the repo's contracts are written in, not arbitrary data flow — and
+every escape hatch is an inline suppression with a written reason, so the
+exemption ships in the same diff as the code it excuses.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ddlpc_tpu.analysis.core import FileContext, Rule, Violation
+
+
+def _call_name(node: ast.AST) -> str:
+    """Dotted name of a call target: ``json.dumps`` / ``open`` / ``fq``."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _contains_call(node: ast.AST, dotted: str) -> bool:
+    return any(
+        isinstance(n, ast.Call) and _call_name(n.func) == dotted
+        for n in ast.walk(node)
+    )
+
+
+def _is_json_dumps(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and _call_name(node.func) in (
+        "json.dumps",
+        "dumps",
+    )
+
+
+# Rules accumulate into a per-rule list surfaced via finalize(); keep the
+# plumbing in one place.
+def ctx_violations(rule: Rule, ctx: FileContext) -> List[Violation]:
+    store = getattr(rule, "_violations", None)
+    if store is None:
+        store = rule._violations = []
+    return store
+
+
+class _CollectingRule(Rule):
+    def finalize(self, root: str) -> List[Violation]:
+        out = getattr(self, "_violations", [])
+        self._violations = []
+        return out
+
+
+class JsonlStampRule(_CollectingRule):
+    """jsonl-stamp: a ``f.write(json.dumps(rec) + "\\n")`` emit site must
+    stamp the record (``obs.schema.stamp``, an explicit ``"schema"`` key,
+    or ``setdefault("schema", ...)`` in the same function).  Pass-throughs
+    that re-emit decoded lines (``json.loads`` inside the dumped
+    expression) are exempt — the stamp rode in on the original record."""
+
+    id = "jsonl-stamp"
+    doc = (
+        "JSONL emit sites must flow through a schema-stamping helper "
+        "(obs/schema.py:stamp) so every stream lints clean"
+    )
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext) -> None:
+        # shape: <something>.write( json.dumps(...) [+ "\n"] )
+        if not (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "write"
+            and len(node.args) == 1
+        ):
+            return
+        arg = node.args[0]
+        dumped: Optional[ast.Call] = None
+        if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add):
+            if _is_json_dumps(arg.left):
+                dumped = arg.left
+        elif _is_json_dumps(arg):
+            dumped = arg
+        if dumped is None:
+            return
+        if any(
+            kw.arg == "indent" for kw in dumped.keywords
+        ):
+            return  # pretty-printed report JSON, not a JSONL stream
+        if any(_is_json_loads(n) for n in ast.walk(dumped)):
+            return  # pass-through of an already-stamped record
+        func = ctx.enclosing_function(node)
+        scope = func if func is not None else ctx.tree
+        if _has_stamp_evidence(scope):
+            return
+        ctx_violations(self, ctx).append(
+            Violation(
+                self.id, ctx.path, node.lineno,
+                "JSONL record written without schema stamping — build the "
+                "record via obs.schema.stamp(...) (or set 'schema' "
+                "explicitly in this function)",
+            )
+        )
+
+
+def _is_json_loads(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and _call_name(node.func) in (
+        "json.loads",
+        "loads",
+    )
+
+
+def _has_stamp_evidence(scope: ast.AST) -> bool:
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Call):
+            name = _call_name(n.func)
+            if name in ("stamp", "schema.stamp") or name.endswith(".stamp"):
+                return True
+            if (
+                isinstance(n.func, ast.Attribute)
+                and n.func.attr == "setdefault"
+                and n.args
+                and isinstance(n.args[0], ast.Constant)
+                and n.args[0].value == "schema"
+            ):
+                return True
+        if isinstance(n, ast.Dict):
+            for k in n.keys:
+                if isinstance(k, ast.Constant) and k.value == "schema":
+                    return True
+        if (
+            isinstance(n, ast.Assign)
+            and len(n.targets) == 1
+            and isinstance(n.targets[0], ast.Subscript)
+        ):
+            s = n.targets[0].slice
+            if isinstance(s, ast.Constant) and s.value == "schema":
+                return True
+    return False
+
+
+class AtomicWriteRule(_CollectingRule):
+    """atomic-write: report/metadata JSONs go to disk via
+    tmp + fsync + rename (``utils.fsio.atomic_write_json`` or a function
+    that performs ``os.replace`` + ``os.fsync`` itself), never a bare
+    ``open(path, "w")`` — a crash mid-write must not leave a torn file
+    where a committed artifact or a restore path expects a whole one."""
+
+    id = "atomic-write"
+    doc = (
+        "JSON report writes use the tmp+rename helpers (utils/fsio.py), "
+        "never bare open(..., 'w')"
+    )
+
+    def _function_is_atomic(self, scope: ast.AST) -> bool:
+        # ``os.replace`` in the same function marks a self-rolled atomic
+        # writer: rename-atomicity (no torn reads) is the invariant this
+        # rule proves.  fsync is a separate DURABILITY decision the
+        # helpers own per call site (fsio.atomic_write_* ``durable=`` —
+        # ~50 ms per fsync on containerized filesystems, so per-epoch
+        # writers opt out explicitly).
+        return any(
+            isinstance(n, ast.Call)
+            and _call_name(n.func) in ("os.replace", "os.rename", "replace")
+            for n in ast.walk(scope)
+        )
+
+    def _open_w_names(self, scope: ast.AST) -> Dict[str, int]:
+        """Names bound to a bare ``open(..., 'w'/'wb')`` in this scope
+        (with-items and assignments)."""
+        names: Dict[str, int] = {}
+
+        def mode_of(call: ast.Call) -> str:
+            if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+                return str(call.args[1].value)
+            for kw in call.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    return str(kw.value.value)
+            return "r"
+
+        for n in ast.walk(scope):
+            call = None
+            target = None
+            if isinstance(n, ast.withitem) and isinstance(
+                n.context_expr, ast.Call
+            ):
+                call, target = n.context_expr, n.optional_vars
+            elif isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                call = n.value
+                if len(n.targets) == 1 and isinstance(n.targets[0], ast.Name):
+                    target = n.targets[0]
+            if (
+                call is not None
+                and _call_name(call.func) == "open"
+                and "w" in mode_of(call)
+                and isinstance(target, ast.Name)
+            ):
+                names[target.id] = call.lineno
+        return names
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext) -> None:
+        func = ctx.enclosing_function(node)
+        scope = func if func is not None else ctx.tree
+        # json.dump(obj, f) where f came from a bare open(..., 'w')
+        hit_line = None
+        if _call_name(node.func) in ("json.dump", "dump"):
+            if len(node.args) >= 2 and isinstance(node.args[1], ast.Name):
+                opens = self._open_w_names(scope)
+                if node.args[1].id in opens:
+                    hit_line = node.lineno
+        # f.write(json.dumps(...)) / f.write(name_bound_to_dumps)
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "write"
+            and isinstance(node.func.value, ast.Name)
+            and len(node.args) == 1
+        ):
+            opens = self._open_w_names(scope)
+            if node.func.value.id in opens:
+                arg = node.args[0]
+                dumped = any(_is_json_dumps(n) for n in ast.walk(arg))
+                if not dumped:
+                    # names in the written expression bound from
+                    # json.dumps earlier in the scope (`out = dumps(...);
+                    # f.write(out + "\n")`)
+                    arg_names = {
+                        n.id
+                        for n in ast.walk(arg)
+                        if isinstance(n, ast.Name)
+                    }
+                    dumped = any(
+                        isinstance(n, ast.Assign)
+                        and len(n.targets) == 1
+                        and isinstance(n.targets[0], ast.Name)
+                        and n.targets[0].id in arg_names
+                        and any(
+                            _is_json_dumps(m) for m in ast.walk(n.value)
+                        )
+                        for n in ast.walk(scope)
+                    )
+                if dumped:
+                    hit_line = node.lineno
+        if hit_line is None:
+            return
+        if self._function_is_atomic(scope):
+            return  # this IS an atomic writer (tmp + fsync + rename)
+        ctx_violations(self, ctx).append(
+            Violation(
+                self.id, ctx.path, hit_line,
+                "JSON written through a bare open(..., 'w') — use "
+                "ddlpc_tpu.utils.fsio.atomic_write_json (tmp + fsync + "
+                "rename) so a crash cannot leave a torn report",
+            )
+        )
+
+
+class MetricDocRule(_CollectingRule):
+    """metric-doc: every constant ``ddlpc_*`` metric name in code appears
+    in docs/OBSERVABILITY.md, and every full metric name in the doc's
+    tables exists in code — drift fails in BOTH directions.  Doc names on
+    lines marked ``(dynamic)`` (or containing ``<key>`` templates) are
+    derived at runtime and exempt from the code-presence direction."""
+
+    id = "metric-doc"
+    doc = (
+        "ddlpc_* metric names in code and docs/OBSERVABILITY.md must "
+        "match exactly, both directions"
+    )
+
+    DOC = os.path.join("docs", "OBSERVABILITY.md")
+    _NAME = re.compile(r"^ddlpc_[a-z0-9_]*[a-z0-9]$")
+    _DOC_TOKEN = re.compile(r"ddlpc_[a-z0-9_<>]*")
+    # names that are identifiers, not metrics, when they appear in prose
+    NON_METRIC = frozenset({"ddlpc_tpu", "ddlpc_check"})
+
+    def __init__(self):
+        self._code_names: Dict[str, Tuple[str, int]] = {}
+
+    def visit_Constant(self, node: ast.Constant, ctx: FileContext) -> None:
+        v = node.value
+        if (
+            isinstance(v, str)
+            and self._NAME.match(v)
+            and v not in self.NON_METRIC
+        ):
+            self._code_names.setdefault(v, (ctx.path, node.lineno))
+
+    def finalize(self, root: str) -> List[Violation]:
+        out = list(getattr(self, "_violations", []))
+        self._violations = []
+        doc_path = os.path.join(root, self.DOC)
+        if not os.path.exists(doc_path):
+            return out  # mini fixture trees without docs skip this rule
+        doc_names: Set[str] = set()
+        dynamic_prefixes: Set[str] = set()
+        with open(doc_path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                for tok in self._DOC_TOKEN.findall(line):
+                    if "<" in tok or tok.endswith("_"):
+                        prefix = tok.split("<")[0]
+                        # the bare family prefix would exempt EVERYTHING;
+                        # a dynamic prefix must name an actual subfamily
+                        if len(prefix) > len("ddlpc_"):
+                            dynamic_prefixes.add(prefix)
+                    elif self._NAME.match(tok) and tok not in self.NON_METRIC:
+                        if "(dynamic)" in line:
+                            dynamic_prefixes.add(tok)
+                        else:
+                            doc_names.add(tok)
+        for name, (path, lineno) in sorted(self._code_names.items()):
+            if name not in doc_names:
+                out.append(
+                    Violation(
+                        self.id, path, lineno,
+                        f"metric {name!r} is emitted here but missing from "
+                        f"{self.DOC} — document it (or it silently "
+                        f"disappears from the operator's map)",
+                    )
+                )
+        for name in sorted(doc_names - set(self._code_names)):
+            if any(name.startswith(p) for p in dynamic_prefixes):
+                continue
+            out.append(
+                Violation(
+                    self.id, doc_path, 1,
+                    f"{self.DOC} documents {name!r} but no code emits it — "
+                    f"stale docs mislead operators; delete the row or mark "
+                    f"the line (dynamic)",
+                )
+            )
+        self._code_names = {}
+        return out
+
+
+class JitHostCallRule(_CollectingRule):
+    """jit-host-call: functions compiled by ``jit``/``pmap``/``shard_map``
+    must not call host-side APIs — ``time.*`` clocks, ``.item()``,
+    ``device_get``, or numpy functions.  Each of these either recompiles
+    per call, forces an implicit device→host transfer, or silently bakes a
+    trace-time constant into the compiled program."""
+
+    id = "jit-host-call"
+    doc = (
+        "no time.time()/.item()/device_get/numpy host calls inside "
+        "functions passed to jit/shard_map/pmap"
+    )
+
+    _WRAPPERS = {"jit", "pmap", "shard_map"}
+    _NP_OK = frozenset(
+        {
+            "float32", "float16", "bfloat16", "int32", "int8", "int64",
+            "uint8", "uint16", "bool_", "float64", "dtype", "pi", "inf",
+            "newaxis",
+        }
+    )
+
+    def begin_file(self, ctx: FileContext) -> None:
+        self._jitted: List[Tuple[ast.AST, str]] = []
+        self._defs: Dict[str, ast.AST] = {}
+
+    def _is_wrapper(self, func: ast.AST) -> bool:
+        name = _call_name(func)
+        return bool(name) and name.split(".")[-1] in self._WRAPPERS
+
+    def visit_FunctionDef(self, node: ast.FunctionDef, ctx: FileContext):
+        self._defs[node.name] = node
+        for dec in node.decorator_list:
+            jitted = self._is_wrapper(dec) or (
+                isinstance(dec, ast.Call)
+                and (
+                    self._is_wrapper(dec.func)
+                    or (
+                        _call_name(dec.func).split(".")[-1] == "partial"
+                        and dec.args
+                        and self._is_wrapper(dec.args[0])
+                    )
+                )
+            )
+            if jitted:
+                self._jitted.append((node, node.name))
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext) -> None:
+        if not self._is_wrapper(node.func) or not node.args:
+            return
+        target = node.args[0]
+        if isinstance(target, ast.Lambda):
+            self._jitted.append((target, "<lambda>"))
+        elif isinstance(target, ast.Name):
+            self._jitted.append((target, target.id))  # resolved at finalize
+
+    def _scan(self, fn: ast.AST, label: str, ctx: FileContext) -> None:
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            name = _call_name(n.func)
+            msg = None
+            if name in ("time.time", "time.monotonic", "time.perf_counter"):
+                msg = f"{name}() is a trace-time constant under jit"
+            elif (
+                isinstance(n.func, ast.Attribute)
+                and n.func.attr == "item"
+                and not n.args
+            ):
+                msg = ".item() forces a device->host sync inside the " \
+                      "compiled function"
+            elif name.split(".")[-1] == "device_get":
+                msg = "device_get inside a jitted function is an implicit " \
+                      "transfer"
+            elif name.split(".")[0] in ("np", "numpy"):
+                attr = name.split(".")[-1]
+                if attr not in self._NP_OK:
+                    msg = (
+                        f"numpy host call {name}() inside a jitted "
+                        f"function runs at trace time, not per step"
+                    )
+            if msg is not None:
+                ctx_violations(self, ctx).append(
+                    Violation(
+                        self.id, ctx.path, n.lineno,
+                        f"in jit-compiled {label!r}: {msg}",
+                    )
+                )
+
+    def end_file(self, ctx: FileContext) -> None:
+        # resolve Name targets recorded during the walk, then scan
+        seen: Set[int] = set()
+        for target, label in self._jitted:
+            fn = target
+            if isinstance(target, ast.Name):
+                fn = self._defs.get(label)
+                if fn is None:
+                    continue
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            self._scan(fn, label, ctx)
+        self._jitted = []
+        self._defs = {}
+
+
+class CodecFenceRule(_CollectingRule):
+    """codec-fence: inside ``parallel/``, the quantization codec runs only
+    through ``grad_sync.apply_codec_fenced`` (or inside a function that
+    cuts its own ``optimization_barrier`` fences at the same points).
+    An unfenced codec call fuses into the surrounding collectives and its
+    bits then depend on which program surrounds it — the exact 1-ulp
+    drift PR 5's bit-identity bar exists to prevent."""
+
+    id = "codec-fence"
+    doc = (
+        "codec invocations in parallel/ go through apply_codec_fenced "
+        "(PR 5 bit-identity fences)"
+    )
+
+    _CODEC_FNS = {"fake_quantize", "fake_quantize_pallas", "fq"}
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext) -> None:
+        if os.sep + "parallel" + os.sep not in ctx.path:
+            return
+        name = _call_name(node.func)
+        if name not in self._CODEC_FNS:
+            return
+        func = ctx.enclosing_function(node)
+        if func is not None and func.name == "apply_codec_fenced":
+            return  # the wrapper itself
+        if func is not None and _contains_call(
+            func, "lax.optimization_barrier"
+        ):
+            return  # function cuts its own fences (ring inline formula)
+        ctx_violations(self, ctx).append(
+            Violation(
+                self.id, ctx.path, node.lineno,
+                f"unfenced codec call {name}(...) in parallel/ — route "
+                f"through grad_sync.apply_codec_fenced so the codec's "
+                f"bits cannot depend on the surrounding program",
+            )
+        )
+
+
+def make_rules() -> List[Rule]:
+    return [
+        JsonlStampRule(),
+        AtomicWriteRule(),
+        MetricDocRule(),
+        JitHostCallRule(),
+        CodecFenceRule(),
+    ]
+
+
+ALL_RULE_IDS = [r.id for r in make_rules()] + [
+    "import-tier",
+    "tier-undeclared",
+    "lock-order",
+    "guarded-by",
+    "bad-suppression",
+    "syntax-error",
+]

@@ -31,6 +31,7 @@ import ml_dtypes
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ddlpc_tpu.analysis import lockcheck
 from ddlpc_tpu.data.datasets import TileDataset, gather_into as _gather_into
 from ddlpc_tpu.utils import native as _native
 
@@ -115,6 +116,7 @@ class _Slot:
         self.scratch_labs = scratch_labs
 
 
+@lockcheck.guarded
 class _HostRing:
     """Fixed pool of preallocated super-batch destination buffers.
 
@@ -131,8 +133,8 @@ class _HostRing:
         # alloc(reuse_scratch_from=None) builds a slot, optionally
         # adopting an existing slot's scratch pair.
         self._alloc = alloc
-        self._cv = threading.Condition()
-        self._slots = [alloc() for _ in range(nslots)]
+        self._cv = lockcheck.condition("_HostRing._cv")
+        self._slots = [alloc() for _ in range(nslots)]  # guarded-by: _cv
 
     def acquire(self) -> _Slot:
         with self._cv:

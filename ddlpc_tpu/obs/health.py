@@ -25,11 +25,12 @@ loop being observed.
 from __future__ import annotations
 
 import math
-import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
+
+from ddlpc_tpu.analysis import lockcheck
 
 
 @dataclass
@@ -170,6 +171,7 @@ class QueueSaturationDetector:
         )
 
 
+@lockcheck.guarded
 class HealthMonitor:
     """Owns the detectors for one process side and fans alerts out to the
     JSONL stream, the metrics registry, and the stall watchdog."""
@@ -192,8 +194,8 @@ class HealthMonitor:
         # threads (/healthz) — same discipline as the watchdog's ring:
         # mutation and iteration under one lock, or CPython raises
         # "deque mutated during iteration" into a scrape.
-        self._alerts: deque = deque(maxlen=max_kept)
-        self._alerts_lock = threading.Lock()
+        self._alerts: deque = deque(maxlen=max_kept)  # guarded-by: _alerts_lock
+        self._alerts_lock = lockcheck.lock("HealthMonitor._alerts_lock")
         self._step_time = EwmaRegressionDetector(factor=step_time_factor)
         self._loss = LossDetector(factor=loss_factor)
         self._queue = QueueSaturationDetector(threshold=queue_threshold)

@@ -230,8 +230,9 @@ class OnDemandProfiler:
             self.out_dir, f"top_ops_{self._capture_n:03d}.json"
         )
         try:
-            with open(path, "w") as f:
-                json.dump(report, f, indent=2)
+            from ddlpc_tpu.utils.fsio import atomic_write_json
+
+            atomic_write_json(path, report)
             report["report_path"] = path
         except OSError as e:  # full disk must not kill the training loop
             report.setdefault("error", f"report not written: {e}")

@@ -10,7 +10,8 @@ honest.  :func:`check_record` is the single owner of what "flat" means.
 
 from __future__ import annotations
 
-from typing import List
+import time
+from typing import List, Optional
 
 # Version of the flat-JSONL record schema.  Bump ONLY on a breaking shape
 # change (a record stops being one flat JSON object per line); adding keys
@@ -36,6 +37,7 @@ KNOWN_KINDS = frozenset(
         "comm",  # communication accounting (obs/comm.py)
         "router",  # fleet router snapshots/events — router.jsonl (serve/router.py)
         "fleet",  # replica supervision events — router.jsonl (serve/fleet.py)
+        "analysis",  # static-analysis reports — analysis.jsonl (scripts/ddlpc_check.py)
     }
 )
 
@@ -89,6 +91,27 @@ def check_record(obj: object) -> List[str]:
             f"(scalars or lists of scalars)"
         )
     return errs
+
+
+def stamp(record: dict, kind: Optional[str] = None) -> dict:
+    """Stamp ``record`` with the stream contract fields, in place.
+
+    The one helper every JSONL emitter that builds records by hand should
+    flow through (``scripts/ddlpc_check.py``'s jsonl-stamp rule looks for
+    it): sets ``schema`` (and ``time``) if absent, and — when ``kind`` is
+    given — a ``kind`` that must already be registered in
+    :data:`KNOWN_KINDS`, so a typo'd emitter fails at the emit site
+    instead of at lint time."""
+    if kind is not None:
+        if kind not in KNOWN_KINDS:
+            raise ValueError(
+                f"unregistered record kind {kind!r} — add it to "
+                f"obs/schema.py:KNOWN_KINDS first"
+            )
+        record.setdefault("kind", kind)
+    record.setdefault("schema", SCHEMA_VERSION)
+    record.setdefault("time", time.time())
+    return record
 
 
 def is_stale(obj: object) -> bool:

@@ -39,6 +39,7 @@ import time
 import uuid
 from typing import Dict, List, Optional
 
+from ddlpc_tpu.analysis import lockcheck
 from ddlpc_tpu.obs.schema import SCHEMA_VERSION
 
 
@@ -107,6 +108,7 @@ class Span:
         return False
 
 
+@lockcheck.guarded
 class Tracer:
     """Trace/span-id issuing clock + exporters; thread-safe throughout.
 
@@ -126,23 +128,23 @@ class Tracer:
         self.service = service
         self.jsonl_path = jsonl_path
         self.chrome_path = chrome_path
-        self.dropped_events = 0
+        self.dropped_events = 0  # guarded-by: _lock
         if not self.enabled:
             return
         self.trace_id = uuid.uuid4().hex[:16]
         self.max_events = int(max_events)
-        self._lock = threading.Lock()
-        self._id = 0
+        self._lock = lockcheck.lock("Tracer._lock")
+        self._id = 0  # guarded-by: _lock
         self._tls = threading.local()
-        self._events: List[dict] = []
-        self._thread_names: Dict[int, str] = {}
+        self._events: list = []  # guarded-by: _lock
+        self._thread_names: dict = {}  # guarded-by: _lock
         # perf_counter is the span clock (monotonic, ns resolution); the
         # wall-clock anchor converts span starts to epoch seconds for the
         # JSONL stream so spans and metrics sort on one time axis.
         self._t0 = time.perf_counter()
         self._epoch0 = time.time() - self._t0
-        self._jsonl: Optional[io.TextIOBase] = None
-        self._jsonl_flushed = self._t0
+        self._jsonl: Optional[io.TextIOBase] = None  # guarded-by: _lock
+        self._jsonl_flushed = self._t0  # guarded-by: _lock
         if jsonl_path is not None:
             os.makedirs(os.path.dirname(jsonl_path) or ".", exist_ok=True)
             self._jsonl = open(jsonl_path, "a")
@@ -300,7 +302,10 @@ class Tracer:
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(doc, f)
-        os.replace(tmp, path)  # readers never see a torn trace.json
+        # Rename-atomic, not fsynced: flush() runs on live cadences and a
+        # trace is diagnostics, not state — readers never see a torn
+        # trace.json, and that is the whole contract here.
+        os.replace(tmp, path)
         return path
 
     def close(self) -> None:

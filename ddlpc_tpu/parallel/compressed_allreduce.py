@@ -166,9 +166,16 @@ def ring_allreduce_mean_quantized(
         local_key = jax.random.fold_in(local_key, lax.axis_index(axis_name))
     if axis_size == 1:
         # Single replica: the mean is the identity; apply the codec's two
-        # quantization points so semantics match the N>1 path.
-        return fake_quantize(
-            fake_quantize(tree, cfg, key=local_key), cfg, key=mean_key
+        # quantization points so semantics match the N>1 path — through
+        # the same fences as every other codec site in parallel/, so the
+        # bits cannot depend on what XLA fuses around this degenerate arm.
+        from ddlpc_tpu.parallel.grad_sync import apply_codec_fenced
+
+        return apply_codec_fenced(
+            fake_quantize,
+            apply_codec_fenced(fake_quantize, tree, cfg, key=local_key),
+            cfg,
+            key=mean_key,
         )
 
     levels = float(levels_for(cfg))

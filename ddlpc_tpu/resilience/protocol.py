@@ -66,6 +66,11 @@ def write_breadcrumb(workdir: str, phase: str, **fields) -> None:
         fd, tmp = tempfile.mkstemp(dir=workdir, suffix=".crumb.tmp")
         with os.fdopen(fd, "w") as f:
             json.dump(crumb, f)
+        # Rename-atomic, deliberately NOT fsynced: breadcrumbs are written
+        # per epoch and fsync costs ~50ms on containerized filesystems —
+        # a reader sees a whole crumb or the previous one, and losing the
+        # newest crumb to power loss only costs one supervisor-side
+        # progress classification (the checkpoint path owns durability).
         os.replace(tmp, os.path.join(workdir, BREADCRUMB))
     except Exception:
         pass

@@ -30,6 +30,8 @@ from concurrent.futures import Future
 from contextlib import nullcontext
 from typing import Callable, List, Optional, Sequence
 
+from ddlpc_tpu.analysis import lockcheck
+
 _NULL_CTX = nullcontext()
 
 
@@ -73,6 +75,7 @@ def _fail(future: Future, exc: Exception) -> None:
         pass
 
 
+@lockcheck.guarded
 class MicroBatcher:
     """Coalesce submitted payloads into batched ``forward`` calls.
 
@@ -80,6 +83,9 @@ class MicroBatcher:
     thread; result ``i`` resolves the future of payload ``i``.  A forward
     exception fails every request in that batch (the typed errors above
     never reach ``forward``).
+
+    Shared state is guarded by ``_cond`` (``# guarded-by:`` annotations
+    below are enforced under ``DDLPC_LOCKCHECK=1`` — docs/ANALYSIS.md).
     """
 
     def __init__(
@@ -105,10 +111,12 @@ class MicroBatcher:
         # cross-thread ``batch_coalesce`` span per batch (oldest member's
         # enqueue → batch take) and a ``jit_execute`` span around forward.
         self.tracer = tracer
-        self._q: deque[_Item] = deque()
-        self._cond = threading.Condition()
-        self._closing = False
-        self.forward_count = 0  # batched forward calls issued (tests/metrics)
+        self._q: deque = deque()  # guarded-by: _cond
+        self._cond = lockcheck.condition("MicroBatcher._cond")
+        self._closing = False  # guarded-by: _cond
+        # batched forward calls issued (read by tests/metrics/chaos hooks
+        # from other threads, so the increment holds the lock too)
+        self.forward_count = 0  # guarded-by: _cond
         self._thread = threading.Thread(
             target=self._run, name="serve-batcher", daemon=True
         )
@@ -222,7 +230,8 @@ class MicroBatcher:
                     live.append(it)
             if not live:
                 continue
-            self.forward_count += 1
+            with self._cond:
+                self.forward_count += 1
             tracer = self.tracer
             if tracer is not None and tracer.enabled:
                 # Cross-thread coalesce wait: the oldest live member's

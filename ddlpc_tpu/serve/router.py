@@ -48,6 +48,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from ddlpc_tpu.analysis import lockcheck
 from ddlpc_tpu.config import FleetConfig
 from ddlpc_tpu.obs.registry import MetricsRegistry
 
@@ -80,6 +81,7 @@ def _percentile(sorted_vals: Sequence[float], q: float) -> Optional[float]:
 # ---------------------------------------------------------------------------
 
 
+@lockcheck.guarded
 class CircuitBreaker:
     """Per-replica error-rate latch with half-open probing.
 
@@ -116,12 +118,12 @@ class CircuitBreaker:
         self.close_after = max(1, int(close_after))
         self._clock = clock
         self._on_transition = on_transition
-        self._lock = threading.Lock()
-        self.state = "closed"
-        self._outcomes: deque = deque(maxlen=self.window)
-        self._open_until = 0.0
-        self._probes_inflight = 0
-        self._probe_successes = 0
+        self._lock = lockcheck.lock("CircuitBreaker._lock")
+        self.state = "closed"  # guarded-by: _lock
+        self._outcomes: deque = deque(maxlen=self.window)  # guarded-by: _lock
+        self._open_until = 0.0  # guarded-by: _lock
+        self._probes_inflight = 0  # guarded-by: _lock
+        self._probe_successes = 0  # guarded-by: _lock
 
     def _transition(self, to: str) -> None:
         self.state = to
@@ -546,11 +548,18 @@ class _Attempt:
         self.t0 = time.monotonic()
 
 
+@lockcheck.guarded
 class FleetRouter:
     """Dispatch requests across replicas; the fleet's one client-facing
     brain.  Thread-safe; replicas come and go at runtime (the supervisor
     registers them as they pass readiness and removes them when their
-    process dies)."""
+    process dies).
+
+    Lock order (enforced by analysis/lockcheck.py under
+    ``DDLPC_LOCKCHECK=1``): ``FleetRouter._lock`` may be held while taking
+    ``CircuitBreaker._lock`` (``_pick`` ranks and admits under the router
+    lock); the reverse never happens — breaker callbacks
+    (``_on_breaker``) log and count without touching the router lock."""
 
     def __init__(
         self,
@@ -566,10 +575,10 @@ class FleetRouter:
         self.logger = logger  # MetricsLogger(basename="router") or None
         self._rng = rng if rng is not None else random.Random()
         self._sleep = sleep
-        self._lock = threading.Lock()
-        self._replicas: Dict[str, _Replica] = {}
-        self._rr = 0  # round-robin tiebreaker
-        self._drain_cond = threading.Condition(self._lock)
+        self._lock = lockcheck.lock("FleetRouter._lock")
+        self._replicas: dict = {}  # guarded-by: _lock
+        self._rr = 0  # guarded-by: _lock (round-robin tiebreaker)
+        self._drain_cond = lockcheck.condition(lock=self._lock)
         self._stop = threading.Event()
         self._scraper: Optional[threading.Thread] = None
         self._emitter: Optional[threading.Thread] = None

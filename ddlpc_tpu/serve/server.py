@@ -329,9 +329,9 @@ class ServingFrontend:
         report["wall_s"] = res.get("seconds")
         path = os.path.join(self.cfg.workdir, f"serve_top_ops_{n:03d}.json")
         try:
-            os.makedirs(self.cfg.workdir, exist_ok=True)
-            with open(path, "w") as f:
-                json.dump(report, f, indent=2)
+            from ddlpc_tpu.utils.fsio import atomic_write_json
+
+            atomic_write_json(path, report)
             report["report_path"] = path
         except OSError as e:
             report.setdefault("error", f"report not written: {e}")

@@ -42,9 +42,11 @@ from typing import Optional
 
 import jax
 
+from ddlpc_tpu.analysis import lockcheck
 from ddlpc_tpu.train import checkpoint as ckpt
 
 
+@lockcheck.guarded
 class AsyncCheckpointer:
     """Background-threaded ``save_checkpoint`` with sync fallback.
 
@@ -52,6 +54,15 @@ class AsyncCheckpointer:
     same snapshot path) — the knob ``TrainConfig.checkpoint_async`` maps
     here, so an A/B between the modes differs only in WHERE the write
     runs, never in what lands on disk.
+
+    Concurrency design: there is deliberately NO lock — ``save``/``wait``
+    /``close`` are single-writer (the training thread), the future is the
+    hand-off, and the ``wait()`` barrier orders every cross-thread read.
+    The ``# guarded-by: <owner-thread>`` annotations pin that shape:
+    under ``DDLPC_LOCKCHECK=1`` a second mutating thread is a violation,
+    not a silent race.  ``last_write_s`` is the one writer-thread field —
+    it is written before the future resolves and only read after the
+    ``wait()`` barrier, so it carries no annotation.
     """
 
     def __init__(
@@ -67,14 +78,14 @@ class AsyncCheckpointer:
         self.chunk_bytes = chunk_bytes
         self.compression = compression
         self.background = background
-        self._pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
-        self._inflight: Optional[concurrent.futures.Future] = None
+        self._pool = None  # guarded-by: <owner-thread>
+        self._inflight = None  # guarded-by: <owner-thread>
         # Observability: what the TRAINING thread paid for the last save
         # (snapshot + any barrier on the previous write) vs what the write
         # actually cost in the background.
-        self.last_stall_s = 0.0
+        self.last_stall_s = 0.0  # guarded-by: <owner-thread>
         self.last_write_s = 0.0
-        self.saves = 0
+        self.saves = 0  # guarded-by: <owner-thread>
 
     def _executor(self) -> concurrent.futures.ThreadPoolExecutor:
         if self._pool is None:

@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from contextlib import contextmanager
 from typing import Dict, Optional
@@ -22,8 +21,10 @@ from typing import Dict, Optional
 import jax
 import numpy as np
 
+from ddlpc_tpu.analysis import lockcheck
 from ddlpc_tpu.obs.registry import sanitize_name
 from ddlpc_tpu.obs.schema import SCHEMA_VERSION
+from ddlpc_tpu.utils.fsio import atomic_write_text
 
 # ISPRS-style 6-class palette (imp surface, building, low veg, tree, car,
 # clutter) extended by hashing for datasets with more classes.
@@ -82,9 +83,15 @@ class MetricsLogger:
         self.jsonl_path = os.path.join(workdir, f"{basename}.jsonl")
         if run_config_json is not None:
             # Run-config header, as the reference writes before epoch 0
-            # (кластер.py:715-716).
-            with open(os.path.join(workdir, "config.json"), "w") as f:
-                f.write(run_config_json)
+            # (кластер.py:715-716) — rename-atomic so restore tooling
+            # never reads a torn config; durable=False because this runs
+            # once per trainer construction and the ~50ms container fsync
+            # would tax every tiny test fit for an advisory file.
+            atomic_write_text(
+                os.path.join(workdir, "config.json"),
+                run_config_json,
+                durable=False,
+            )
 
     def attach_registry(self, registry) -> None:
         """Wire (or re-wire) a MetricsRegistry after construction — the
@@ -140,6 +147,7 @@ class MetricsLogger:
             ).set(float(v))
 
 
+@lockcheck.guarded
 class StageTimer:
     """Named wall-clock stage timing — the structured form of the
     reference's scattered ``time.time()`` delta prints (кластер.py:265-440).
@@ -156,10 +164,10 @@ class StageTimer:
     cross-thread ``add_span`` (no implicit parent)."""
 
     def __init__(self, tracer=None):
-        self.totals: Dict[str, float] = {}
-        self.counts: Dict[str, int] = {}
+        self.totals: Dict[str, float] = {}  # guarded-by: _lock
+        self.counts: Dict[str, int] = {}  # guarded-by: _lock
         self.tracer = tracer
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock("StageTimer._lock")
 
     @contextmanager
     def stage(self, name: str):

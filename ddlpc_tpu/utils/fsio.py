@@ -1,0 +1,83 @@
+"""Atomic, durable small-file writes — the one helper for report JSONs.
+
+``train/checkpoint.py`` owns the heavyweight chunked-blob write path and
+``resilience/protocol.py`` owns breadcrumbs; everything else in the repo
+that drops a small JSON (bench reports, perf-gate baselines, analyzer
+summaries) goes through here.  The discipline is the same everywhere:
+write to a temp file in the destination directory, fsync, then
+``os.replace`` — a crash mid-write can never leave a torn or empty file
+where a reader (or a committed artifact) expects a whole one.
+
+``scripts/ddlpc_check.py``'s ``atomic-write`` rule flags bare
+``open(path, "w")`` + ``json.dump`` emit sites and points here.
+
+Pure stdlib (no jax, no numpy) so every tier can import it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Optional
+
+
+def atomic_write_text(
+    path: str,
+    text: str,
+    durable: bool = True,
+    fsync_dir: bool = False,
+) -> str:
+    """Write ``text`` to ``path`` via tmp + fsync + rename; returns path.
+
+    ``durable=False`` skips the file fsync (keeping only rename
+    atomicity) — for frequently-rewritten advisory files on hot paths:
+    fsync costs ~50 ms on containerized filesystems, so a per-epoch
+    caller must opt out explicitly and own the argument.  ``fsync_dir=
+    True`` additionally fsyncs the containing directory so the RENAME
+    itself survives a power loss (the checkpoint-grade guarantee);
+    report JSONs normally skip it — one dirent is not worth a directory
+    sync per bench row.
+    """
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        # mkstemp creates 0600; restore the umask-default mode so the
+        # rename can't silently tighten permissions on reports/baselines
+        # that other uids (artifact collectors, scrapers) read.
+        umask = os.umask(0)
+        os.umask(umask)
+        os.fchmod(fd, 0o666 & ~umask)
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+            if durable:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    if fsync_dir:
+        dir_fd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
+    return path
+
+
+def atomic_write_json(
+    path: str,
+    obj: Any,
+    indent: Optional[int] = 2,
+    durable: bool = True,
+    fsync_dir: bool = False,
+) -> str:
+    """``json.dump`` with the tmp + fsync + rename discipline."""
+    return atomic_write_text(
+        path,
+        json.dumps(obj, indent=indent) + "\n",
+        durable=durable,
+        fsync_dir=fsync_dir,
+    )

@@ -358,9 +358,9 @@ def main(argv=None) -> int:
     out = json.dumps(report, indent=2)
     print(out)
     if args.out:
-        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-        with open(args.out, "w") as f:
-            f.write(out + "\n")
+        from ddlpc_tpu.utils.fsio import atomic_write_text
+
+        atomic_write_text(args.out, out + "\n")
     # driver-contract line
     print(
         f"chaos_soak_survived={int(report['survived'])} "

@@ -4,12 +4,16 @@ Every JSONL stream a run emits — metrics.jsonl (training records plus the
 interleaved alert and kind="perf"/"comm" accounting records),
 serve_metrics.jsonl, spans.jsonl, serve_spans.jsonl, resilience.jsonl
 (the supervisor's attempt/give-up stream), router.jsonl (the fleet
-router/supervisor stream) — must be one FLAT JSON object
+router/supervisor stream), and analysis.jsonl (the static-analyzer's
+kind="analysis" report stream, scripts/ddlpc_check.py --out) — must be
+one FLAT JSON object
 per line (scalars or lists of scalars) carrying an integer ``schema``
 field and a ``kind`` registered in obs/schema.py:KNOWN_KINDS.  That
 contract is what lets scripts/obs_tail.py tail any stream unchanged and
 lets downstream tooling parse without per-stream special cases; this lint
-(invoked from tier-1: tests/test_obs.py) keeps emitters honest.
+(invoked from tier-1: tests/test_obs.py, tests/test_analysis.py) keeps
+emitters honest — runtime telemetry and static-analysis reports go
+through the same entry point.
 
 Usage:
     python scripts/check_metrics_schema.py runs/flagship            # run dir
@@ -35,7 +39,10 @@ from ddlpc_tpu.obs.schema import SCHEMA_VERSION, check_record, is_stale  # noqa:
 
 
 def lint_file(
-    path: str, max_violations: int = 20, stale_out: Optional[List[int]] = None
+    path: str,
+    max_violations: int = 20,
+    stale_out: Optional[List[int]] = None,
+    kind_counts: Optional[dict] = None,
 ) -> List[str]:
     """``path:line: message`` strings for every contract violation.
 
@@ -43,7 +50,9 @@ def lint_file(
     tolerated — a long-lived run must survive an in-place tooling upgrade
     — but counted into ``stale_out[0]`` so the summary can report them;
     only a version NEWER than this tooling's is a violation
-    (obs/schema.py:check_record).
+    (obs/schema.py:check_record).  ``kind_counts`` (dict) tallies records
+    per ``kind`` so the summary shows what the linted streams carry —
+    runtime telemetry and ``analysis`` reports alike.
     """
     out: List[str] = []
     with open(path, "r") as f:
@@ -61,6 +70,10 @@ def lint_file(
                 continue
             if stale_out is not None and is_stale(obj):
                 stale_out[0] += 1
+            if kind_counts is not None and isinstance(obj, dict):
+                kind = obj.get("kind", "train")
+                if isinstance(kind, str):
+                    kind_counts[kind] = kind_counts.get(kind, 0) + 1
             for err in check_record(obj):
                 out.append(f"{path}:{lineno}: {err}")
     return out
@@ -89,11 +102,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     violations: List[str] = []
     checked = 0
     stale = [0]
+    kinds: dict = {}
     for path in files:
         checked += 1
         violations.extend(
             lint_file(
-                path, max_violations=args.max_violations, stale_out=stale
+                path,
+                max_violations=args.max_violations,
+                stale_out=stale,
+                kind_counts=kinds,
             )
         )
     for v in violations:
@@ -104,9 +121,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         if stale[0]
         else ""
     )
+    kinds_note = (
+        " [" + ", ".join(f"{k}={n}" for k, n in sorted(kinds.items())) + "]"
+        if kinds
+        else ""
+    )
     print(
         f"check_metrics_schema: {checked} file(s), "
-        f"{len(violations)} violation(s){stale_note}",
+        f"{len(violations)} violation(s){stale_note}{kinds_note}",
         file=sys.stderr,
     )
     return 1 if violations else 0

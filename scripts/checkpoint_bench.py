@@ -35,6 +35,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from ddlpc_tpu.train import checkpoint as ckpt  # noqa: E402
 from ddlpc_tpu.train.async_checkpoint import AsyncCheckpointer  # noqa: E402
+from ddlpc_tpu.utils.fsio import atomic_write_json  # noqa: E402
 
 
 def build_state(size_mb: int, seed: int = 0) -> dict:
@@ -205,8 +206,7 @@ def main(argv=None) -> int:
     )
 
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
-    with open(args.out, "w") as f:
-        json.dump(results, f, indent=2)
+    atomic_write_json(args.out, results)
     if args.workdir is None:
         shutil.rmtree(scratch, ignore_errors=True)
     print(

@@ -49,6 +49,8 @@ from ddlpc_tpu.parallel.train_step import (
     make_train_step,
 )
 from ddlpc_tpu.train.optim import build_optimizer
+from ddlpc_tpu.obs.schema import stamp  # noqa: E402
+from ddlpc_tpu.utils.fsio import atomic_write_json, atomic_write_text  # noqa: E402
 
 
 def run_variant(
@@ -185,7 +187,11 @@ def run_variant(
     log_path = os.path.join(outdir, f"{tag}.jsonl")
     rng = np.random.default_rng(seed)
     rec = {}
-    with open(log_path, "w") as log:
+    # Fresh stream per variant run, appended per epoch like every other
+    # JSONL emitter (a torn rerun must not leave half-truncated rows).
+    if os.path.exists(log_path):
+        os.unlink(log_path)
+    with open(log_path, "a") as log:
         for epoch in range(epochs):
             perm = rng.permutation(n)
             perm = np.resize(perm, -(-n // super_batch) * super_batch)
@@ -207,7 +213,10 @@ def run_variant(
             }
             if (epoch + 1) % 5 == 0 or epoch == epochs - 1:
                 rec.update(evaluate())
-            log.write(json.dumps(rec) + "\n")
+            # stamp() mutates in place — stamp a copy so the returned rec
+            # (merged into the committed summary.json) stays free of the
+            # wall-clock "time" field, which would churn artifact diffs.
+            log.write(json.dumps(stamp(dict(rec))) + "\n")
             log.flush()
     return rec
 
@@ -225,8 +234,7 @@ def merge_summary(
         with open(summary_path) as f:
             merged = {r["tag"]: r for r in json.load(f)}
     merged.update({r["tag"]: r for r in results})
-    with open(summary_path, "w") as f:
-        json.dump(list(merged.values()), f, indent=2)
+    atomic_write_json(summary_path, list(merged.values()))
 
 
 def main() -> None:
@@ -326,11 +334,14 @@ def main() -> None:
             rec = dict(src, tag=tag)
             # Rewrite the per-epoch records' tag too, so consumers grouping
             # jsonl lines by tag (not filename) attribute them correctly.
-            with open(os.path.join(args.outdir, f"{src_tag}.jsonl")) as fin, open(
-                os.path.join(args.outdir, f"{tag}.jsonl"), "w"
-            ) as fout:
-                for line in fin:
-                    fout.write(json.dumps(dict(json.loads(line), tag=tag)) + "\n")
+            with open(os.path.join(args.outdir, f"{src_tag}.jsonl")) as fin:
+                retagged = "".join(
+                    json.dumps(dict(json.loads(line), tag=tag)) + "\n"
+                    for line in fin
+                )
+            atomic_write_text(
+                os.path.join(args.outdir, f"{tag}.jsonl"), retagged
+            )
         else:
             rec = run_variant(
                 tag,

@@ -1,0 +1,200 @@
+"""ddlpc-check: the project invariant analyzer (docs/ANALYSIS.md).
+
+One command proves the codebase contracts the test suite can't see from
+outputs alone:
+
+- **import tiers** — serve/router, serve/fleet, resilience/* are jax-free
+  *transitively* (the property that makes fleet restarts fast), every
+  ``ddlpc_tpu`` module declared in ``analysis/tiers.py:MODULE_TIERS``;
+- **AST rules** — schema-stamped JSONL emits, metric-name ↔
+  docs/OBSERVABILITY.md drift (both directions), tmp+fsync+rename report
+  writes, no host calls inside jitted functions, fenced codec calls in
+  ``parallel/``;
+- **lock order** — the instrumented-lock smoke (analysis/lockcheck.py)
+  runs the threaded hot spots and fails on acquisition-graph cycles or
+  ``# guarded-by:`` violations.
+
+Usage:
+    python scripts/ddlpc_check.py                       # whole tree
+    python scripts/ddlpc_check.py --rules metric-doc    # one rule
+    python scripts/ddlpc_check.py --out runs/analysis.jsonl
+    python scripts/ddlpc_check.py --list-rules
+    python scripts/ddlpc_check.py --sanitize            # + make -C csrc sanitize
+
+Violations print as ``path:line: [rule] message``; suppressed ones are
+counted in the summary.  The ``--out`` stream is flat ``kind="analysis"``
+records (obs/schema.py contract) — ``scripts/check_metrics_schema.py``
+and ``scripts/obs_tail.py`` read it like any other stream.
+
+Exit status: 0 clean, 1 unsuppressed violations, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import os
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from ddlpc_tpu.analysis import lockcheck  # noqa: E402
+from ddlpc_tpu.analysis.core import Violation, run_analysis  # noqa: E402
+from ddlpc_tpu.analysis.rules import ALL_RULE_IDS, make_rules  # noqa: E402
+from ddlpc_tpu.obs.schema import check_record, stamp  # noqa: E402
+from ddlpc_tpu.utils.fsio import atomic_write_text  # noqa: E402
+
+
+def _run_lock_fixture(spec: str) -> List[Violation]:
+    """Import ``module:callable``, run it under lockcheck, return
+    lock-order / guarded-by violations as analyzer violations.  The
+    previous enabled state is restored — tests drive this in-process."""
+    mod_name, _, fn_name = spec.partition(":")
+    was_enabled = lockcheck.enabled()
+    lockcheck.enable()
+    lockcheck.reset()
+    try:
+        fn = getattr(importlib.import_module(mod_name), fn_name)
+        fn()
+        out: List[Violation] = []
+        for v in lockcheck.violations():
+            rule = (
+                "guarded-by" if v.startswith("guarded-by:") else "lock-order"
+            )
+            out.append(Violation(rule, spec, 0, v))
+        return out
+    finally:
+        if not was_enabled:
+            lockcheck.disable()
+        lockcheck.reset()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=_REPO,
+                    help="tree to analyze (default: this repo)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids (default: all)")
+    ap.add_argument("--out", default=None,
+                    help="write the kind='analysis' JSONL stream here")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--no-lockcheck", action="store_true",
+                    help="skip the runtime lock-order smoke")
+    ap.add_argument("--lockcheck-fixture",
+                    default="ddlpc_tpu.analysis.lock_fixtures:run_smoke",
+                    help="module:callable to run under lockcheck")
+    ap.add_argument("--sanitize", action="store_true",
+                    help="also run `make -C csrc sanitize`")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in make_rules():
+            print(f"{r.id:14s} {r.doc}")
+        for extra in ("import-tier", "tier-undeclared", "lock-order",
+                      "guarded-by", "bad-suppression"):
+            print(f"{extra:14s} (see docs/ANALYSIS.md)")
+        return 0
+
+    t0 = time.perf_counter()
+    rule_ids = (
+        set(args.rules.split(",")) if args.rules else None
+    )
+    if rule_ids is not None:
+        known = set(ALL_RULE_IDS) | {
+            "import-tier", "tier-undeclared", "lock-order", "guarded-by",
+            "bad-suppression", "syntax-error",
+        }
+        unknown = rule_ids - known
+        if unknown:
+            # a typo'd --rules must not pass as "0 violations, 0 rules run"
+            print(
+                f"ddlpc_check: unknown rule id(s): {', '.join(sorted(unknown))}"
+                f" (see --list-rules)",
+                file=sys.stderr,
+            )
+            return 2
+    root = os.path.abspath(args.root)
+    result = run_analysis(root, rule_ids=rule_ids)
+    violations = list(result.violations)
+
+    lock_wanted = rule_ids is None or bool(
+        {"lock-order", "guarded-by"} & rule_ids
+    )
+    if not args.no_lockcheck and lock_wanted:
+        try:
+            violations.extend(_run_lock_fixture(args.lockcheck_fixture))
+        except Exception as e:
+            print(f"ddlpc_check: lockcheck fixture failed: {e}",
+                  file=sys.stderr)
+            return 2
+
+    if args.sanitize:
+        rc = subprocess.call(["make", "-C", os.path.join(root, "csrc"),
+                              "sanitize"])
+        if rc != 0:
+            violations.append(
+                Violation("sanitize", "csrc", 0,
+                          "sanitized build failed (make -C csrc sanitize)")
+            )
+
+    unsuppressed = [v for v in violations if not v.suppressed]
+    suppressed = [v for v in violations if v.suppressed]
+    for v in violations:
+        print(v.format().replace(root + os.sep, ""))
+
+    duration = time.perf_counter() - t0
+    if args.out:
+        lines = []
+        for v in violations:
+            rec = stamp(
+                {
+                    "rule": v.rule,
+                    "path": os.path.relpath(v.path, root)
+                    if os.path.isabs(v.path)
+                    else v.path,
+                    "line": v.line,
+                    "message": v.message,
+                    "suppressed": v.suppressed,
+                    "reason": v.reason,
+                },
+                kind="analysis",
+            )
+            errs = check_record(rec)
+            if errs:  # self-lint: the analyzer must obey the contract
+                print(f"ddlpc_check: malformed record: {errs}",
+                      file=sys.stderr)
+                return 2
+            lines.append(rec)
+        summary = stamp(
+            {
+                "rule": "summary",
+                "files_scanned": result.files_scanned,
+                "violations": len(unsuppressed),
+                "suppressed": len(suppressed),
+                "duration_s": round(duration, 3),
+                "rules_run": ",".join(result.rules_run),
+            },
+            kind="analysis",
+        )
+        lines.append(summary)
+        import json
+
+        atomic_write_text(
+            args.out, "".join(json.dumps(r) + "\n" for r in lines)
+        )
+
+    print(
+        f"ddlpc_check: {result.files_scanned} files, "
+        f"{len(unsuppressed)} violation(s), {len(suppressed)} suppressed "
+        f"(with reasons), {duration:.1f}s",
+        file=sys.stderr,
+    )
+    return 1 if unsuppressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

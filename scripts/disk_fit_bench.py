@@ -195,21 +195,21 @@ def main() -> int:
     for r in results:
         print(json.dumps(r), flush=True)
     os.makedirs(args.outdir, exist_ok=True)
-    with open(os.path.join(args.outdir, "run.json"), "w") as f:
-        json.dump(
-            {
-                "note": (
-                    "Flagship-arch fit() from DISK through the REAL "
-                    "converter output and the ShardedLoader host-upload "
-                    "path (device_cache=False) on the default backend.  "
-                    "tiles_per_s measures the tunneled host link, not the "
-                    "chip — see docs/PERF.md."
-                ),
-                "runs": results,
-            },
-            f,
-            indent=2,
-        )
+    from ddlpc_tpu.utils.fsio import atomic_write_json
+
+    atomic_write_json(
+        os.path.join(args.outdir, "run.json"),
+        {
+            "note": (
+                "Flagship-arch fit() from DISK through the REAL "
+                "converter output and the ShardedLoader host-upload "
+                "path (device_cache=False) on the default backend.  "
+                "tiles_per_s measures the tunneled host link, not the "
+                "chip — see docs/PERF.md."
+            ),
+            "runs": results,
+        },
+    )
     print("disk fit bench OK")
     return 0
 

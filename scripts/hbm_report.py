@@ -27,6 +27,7 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from ddlpc_tpu.utils.fsio import atomic_write_json  # noqa: E402
 
 
 def _leaf_bytes_per_device(tree) -> int:
@@ -170,8 +171,7 @@ def main() -> None:
         ),
     }
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-    with open(args.out, "w") as f:
-        json.dump(report, f, indent=2)
+    atomic_write_json(args.out, report)
     print(json.dumps(report))
 
 

@@ -28,6 +28,7 @@ import sys
 
 _SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_SCRIPTS_DIR))
+from ddlpc_tpu.utils.fsio import atomic_write_json  # noqa: E402
 
 import bench  # noqa: E402
 
@@ -145,8 +146,7 @@ def main() -> None:
         print(json.dumps(rec), flush=True)
         # Write after EVERY candidate: a hung arm (the s2d_h64_fullres HBM
         # hang) must not lose the finished rows.
-        with open(out_path, "w") as f:
-            json.dump(list(results.values()), f, indent=2)
+        atomic_write_json(out_path, list(results.values()))
 
 
 if __name__ == "__main__":

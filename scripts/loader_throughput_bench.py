@@ -48,6 +48,7 @@ import time
 
 _SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_SCRIPTS_DIR))
+from ddlpc_tpu.utils.fsio import atomic_write_json  # noqa: E402
 
 
 def main() -> None:
@@ -215,8 +216,7 @@ def main() -> None:
         merged = json.load(open(args.out))
     merged[key] = rec
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
-    with open(args.out, "w") as f:
-        json.dump(merged, f, indent=2)
+    atomic_write_json(args.out, merged)
     print(json.dumps({key: rec}))
     # Driver contract (same shape as bench.py / serve_bench.py): exactly
     # one {"metric": ...} line, last on stdout.  The gather arm is the

@@ -53,6 +53,7 @@ from typing import Dict, List, Optional
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 sys.path.insert(0, os.path.join(_REPO, "scripts"))
+from ddlpc_tpu.utils.fsio import atomic_write_json  # noqa: E402
 
 BASELINE_SCHEMA = 1
 DEFAULT_BASELINE = os.path.join(_REPO, "docs", "perf", "baseline.json")
@@ -484,15 +485,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(json.dumps({"measured": measured}))
     if args.out:
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-        with open(args.out, "w") as f:
-            json.dump(measured, f, indent=2)
+        atomic_write_json(args.out, measured)
 
     if args.update_baseline:
         baseline = build_baseline(measured)
         os.makedirs(os.path.dirname(args.baseline) or ".", exist_ok=True)
-        with open(args.baseline, "w") as f:
-            json.dump(baseline, f, indent=2)
-            f.write("\n")
+        atomic_write_json(args.baseline, baseline)
         print(f"perf_gate: baseline written to {args.baseline}")
         return 0
 

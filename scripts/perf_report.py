@@ -32,6 +32,7 @@ import sys
 from typing import Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from ddlpc_tpu.utils.fsio import atomic_write_json  # noqa: E402
 
 
 def last_records(path: str) -> Dict[str, dict]:
@@ -149,8 +150,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(render(report))
     if args.json:
         os.makedirs(os.path.dirname(args.json) or ".", exist_ok=True)
-        with open(args.json, "w") as f:
-            json.dump(report, f, indent=2)
+        atomic_write_json(args.json, report)
     return 0
 
 

@@ -33,6 +33,7 @@ import time
 def child(rank: int, port: int, elements: int, out: str, procs: int) -> None:
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from ddlpc_tpu.utils.compat import force_cpu_devices
+    from ddlpc_tpu.utils.fsio import atomic_write_json
 
     # 1 device/process: every collective hop crosses the process boundary —
     # no intra-process shortcut.
@@ -149,8 +150,7 @@ def child(rank: int, port: int, elements: int, out: str, procs: int) -> None:
         rows_all = [r for r in rows_all if r.get("processes") != procs]
         rows_all.append(report)
         rows_all.sort(key=lambda r: r.get("processes", 0))
-        with open(out, "w") as f:
-            json.dump(rows_all, f, indent=2)
+        atomic_write_json(out, rows_all)
         print(json.dumps({k: v for k, v in report.items() if k != "note"}))
 
 

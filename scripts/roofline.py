@@ -53,6 +53,7 @@ from ddlpc_tpu.config import ExperimentConfig
 # same hoist PR 6 did for the xplane aggregation.  Re-exported here so
 # older imports of scripts.roofline keep working.
 from ddlpc_tpu.obs.flops import collect_convs, conv_flops, iter_eqns  # noqa: F401
+from ddlpc_tpu.utils.fsio import atomic_write_json  # noqa: E402
 
 
 # --------------------------------------------------------------------------
@@ -202,8 +203,7 @@ def main() -> None:
         )
         if args.out:  # incremental: a tunnel death loses nothing
             os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-            with open(args.out, "w") as f:
-                json.dump({"partial": True, "convs": rows}, f, indent=2)
+            atomic_write_json(args.out, {"partial": True, "convs": rows})
     fallback = float(np.median(measured_tputs)) if measured_tputs else float("nan")
     for row, raw, (key, c) in zip(rows, raw_tputs, ordered):
         tput = raw if raw is not None else fallback
@@ -241,8 +241,7 @@ def main() -> None:
     print(json.dumps({k: v for k, v in summary.items() if k != "convs"}))
     if args.out:
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-        with open(args.out, "w") as f:
-            json.dump(summary, f, indent=2)
+        atomic_write_json(args.out, summary)
 
 
 if __name__ == "__main__":

@@ -49,6 +49,8 @@ sys.path.insert(0, _SCRIPTS_DIR)
 
 import numpy as np
 
+from ddlpc_tpu.utils.fsio import atomic_write_json  # noqa: E402
+
 # Vaihingen's 33 mosaic tiles vary around ~2500×2000; reproduce that
 # spread so no single shape hides a stride bug.
 SIZES = [(2566, 1893), (2428, 2006), (2500, 1934), (1281, 2336),
@@ -285,8 +287,7 @@ def main() -> None:
         print(msg, flush=True)
 
         os.makedirs(os.path.dirname(args.out), exist_ok=True)
-        with open(args.out, "w") as f:
-            json.dump(rec, f, indent=2)
+        atomic_write_json(args.out, rec)
         print(f"wrote {args.out}", flush=True)
     finally:
         if root_ctx:

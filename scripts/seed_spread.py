@@ -37,6 +37,7 @@ import sys
 _SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_SCRIPTS_DIR))
 sys.path.insert(0, _SCRIPTS_DIR)
+from ddlpc_tpu.utils.fsio import atomic_write_json  # noqa: E402
 
 from convergence_ab import merge_summary, run_variant  # noqa: E402
 
@@ -194,8 +195,7 @@ def aggregate() -> dict:
         }
     out["decisions"] = decisions
     os.makedirs(OUTDIR, exist_ok=True)
-    with open(os.path.join(OUTDIR, "spread.json"), "w") as f:
-        json.dump(out, f, indent=2)
+    atomic_write_json(os.path.join(OUTDIR, "spread.json"), out)
     print(json.dumps(out["decisions"], indent=2))
     return out
 

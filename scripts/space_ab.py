@@ -49,6 +49,7 @@ from ddlpc_tpu.parallel.train_step import (create_train_state, make_eval_step,
                                            make_train_step,
                                            make_train_step_gspmd)
 from ddlpc_tpu.train.optim import build_optimizer
+from ddlpc_tpu.utils.fsio import atomic_write_json  # noqa: E402
 
 DATA, SPACE, MODE = %(data)d, %(space)d, %(mode)r
 
@@ -169,8 +170,7 @@ def main() -> int:
                 }
             )
     out = os.path.join(_REPO, "docs", "space_ab.json")
-    with open(out, "w") as f:
-        json.dump(report, f, indent=2)
+    atomic_write_json(out, report)
     # Assert AFTER writing so a failing pair still leaves the evidence.
     for e in report["equivalence"]:
         assert e["trajectories_match"], (

@@ -27,6 +27,7 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from ddlpc_tpu.utils.fsio import atomic_write_json  # noqa: E402
 
 import numpy as np
 
@@ -269,8 +270,7 @@ def main() -> None:
             delta=round(jm - tm, 4),
         )
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-    with open(args.out, "w") as f:
-        json.dump(summary, f, indent=2)
+    atomic_write_json(args.out, summary)
     print(json.dumps(summary))
 
 

@@ -46,6 +46,7 @@ from ddlpc_tpu.parallel.train_step import (  # noqa: E402
     make_train_step,
 )
 from ddlpc_tpu.train.optim import build_optimizer  # noqa: E402
+from ddlpc_tpu.utils.fsio import atomic_write_json  # noqa: E402
 
 
 def main() -> None:
@@ -118,8 +119,7 @@ def main() -> None:
     }
     os.makedirs(args.outdir, exist_ok=True)
     path = os.path.join(args.outdir, f"trace_{args.tag}.json")
-    with open(path, "w") as f:
-        json.dump(out, f, indent=2)
+    atomic_write_json(path, out)
     print(json.dumps(out["top_self_time"][:12], indent=1))
     print("->", path)
 

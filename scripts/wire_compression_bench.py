@@ -35,6 +35,7 @@ import time
 
 _SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_SCRIPTS_DIR))
+from ddlpc_tpu.utils.fsio import atomic_write_json  # noqa: E402
 
 CHUNK = 256 * 1024
 
@@ -267,8 +268,7 @@ def main() -> int:
         ),
     }
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-    with open(args.out, "w") as f:
-        json.dump(report, f, indent=2)
+    atomic_write_json(args.out, report)
     print("wire compression bench OK")
     return 0
 

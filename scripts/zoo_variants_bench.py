@@ -23,6 +23,7 @@ import sys
 
 _SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_SCRIPTS_DIR))
+from ddlpc_tpu.utils.fsio import atomic_write_json  # noqa: E402
 
 import bench  # noqa: E402
 
@@ -96,8 +97,7 @@ def main() -> None:
         print(json.dumps(rec), flush=True)
         # Write after every row (see head_bench.py: a hung arm must not
         # lose finished results).
-        with open(out_path, "w") as f:
-            json.dump(list(results.values()), f, indent=2)
+        atomic_write_json(out_path, list(results.values()))
 
 
 if __name__ == "__main__":
