@@ -76,8 +76,13 @@ MODULE_TIERS: Dict[str, str] = {
     # serve/__init__ (numpy via the engine) — tier describes the runtime
     # import closure, parent packages included.
     "ddlpc_tpu.serve.batching": HOST,
+    "ddlpc_tpu.serve.cbatch": HOST,
     "ddlpc_tpu.serve.metrics": HOST,
     "ddlpc_tpu.serve.engine": HOST,
+    # quantized's own imports are lazy (jax at quantization time, like
+    # obs/profiling) so the engine can import it without paying jax;
+    # router/fleet stay provably jax-free either way.
+    "ddlpc_tpu.serve.quantized": HOST,
     "ddlpc_tpu.serve.server": HOST,
     "ddlpc_tpu.serve.router": HOST,
     "ddlpc_tpu.serve.fleet": HOST,

@@ -391,9 +391,36 @@ class ServeConfig:
     host: str = "127.0.0.1"
     port: int = 8571
     max_batch: int = 8  # tiles coalesced into one forward
-    max_wait_ms: float = 5.0  # max coalescing latency under light load
+    max_wait_ms: float = 5.0  # max coalescing latency (coalesce batcher only)
     queue_limit: int = 64  # admission bound (tiles), then Overloaded
     deadline_ms: float = 2000.0  # per-request queue deadline; 0 = none
+    # Admission loop (serve/cbatch.py vs serve/batching.py).
+    # 'continuous' (default): ``slots`` worker threads each dispatch
+    # whatever is queued the moment they free — no coalescing timer;
+    # batching emerges from busy slots, and a freed slot refills the
+    # device pipeline without draining.  'coalesce' is PR 1's
+    # single-worker coalesce-and-wait MicroBatcher.
+    batcher: str = "continuous"  # continuous | coalesce
+    slots: int = 2  # concurrent in-flight forwards (continuous batcher)
+    # Priority classes (continuous batcher): bulk tiling work
+    # (?priority=batch) gets its own deep admission queue and a
+    # starvation bound — at least one batch-class item is seated every
+    # ``starvation_every``-th assembly — so it queues or sheds without
+    # touching interactive p99.
+    batch_queue_limit: int = 256  # bulk-class admission bound (tiles)
+    starvation_every: int = 4
+    # Weight quantization for the restored params (serve/quantized.py):
+    # 'bf16' (shipped default — ½ the param HBM, within noise of fp32 on
+    # the hard task) | 'int8' (¼ the HBM, per-leaf max-abs scales,
+    # within 1 mIoU point on the hard task) | 'off'.  Scales are
+    # computed once per restore/reload; dequant is fused into the jitted
+    # forward (docs/SERVING.md "Continuous batching & quantized
+    # inference").
+    quantize: str = "bf16"  # off | int8 | bf16
+    # Additionally cast input activations to bf16 inside the compiled
+    # forward.  Off by default; enable only where the committed hard-task
+    # table holds for your model (docs/SERVING.md).
+    quantize_activations: bool = False
     overlap: float = 0.25  # sliding-window overlap for full scenes
     metrics_window: int = 2048  # latency ring size for p50/p95/p99
     metrics_every_s: float = 10.0  # periodic JSONL snapshot cadence; 0 = off
@@ -462,6 +489,24 @@ class FleetConfig:
     queue_limit: int = 64
     deadline_ms: float = 2000.0
     overlap: float = 0.25
+    batcher: str = "continuous"  # continuous | coalesce (serve/cbatch.py)
+    slots: int = 2
+    batch_queue_limit: int = 256
+    starvation_every: int = 4
+    quantize: str = "bf16"  # off | int8 | bf16 (serve/quantized.py)
+    quantize_activations: bool = False
+    # Router-side bulk shedding: when EVERY eligible replica's scraped
+    # interactive queue depth is at or above this, ?priority=batch
+    # requests are shed with a 503 at the router (interactive traffic is
+    # never shed by this rule).  0 disables.
+    batch_shed_queue_depth: int = 0
+    # Bounded wait at admission when ZERO replicas are eligible: a
+    # rolling reload's drain→readmit hand-off, a relaunch-readiness gap,
+    # and a breaker cooldown can momentarily coincide — transient
+    # total-outage blips that should surface as tail latency, not
+    # client-visible 503s.  A request that still finds no replica after
+    # this wait gets the 503.  0 = fail fast.
+    no_replica_wait_ms: float = 1000.0
     # Dispatch: per-attempt replica timeout; a timed-out/failed attempt
     # retries on a DIFFERENT replica up to ``retries`` times with
     # full-jitter backoff; after ``hedge_ms`` without a response a
@@ -537,6 +582,12 @@ class FleetConfig:
             queue_limit=self.queue_limit,
             deadline_ms=self.deadline_ms,
             overlap=self.overlap,
+            batcher=self.batcher,
+            slots=self.slots,
+            batch_queue_limit=self.batch_queue_limit,
+            starvation_every=self.starvation_every,
+            quantize=self.quantize,
+            quantize_activations=self.quantize_activations,
             drain_timeout_s=self.drain_timeout_s,
             metrics_dir=metrics_dir,
         )

@@ -29,6 +29,7 @@ KNOWN_KINDS = frozenset(
         "alert",  # health detectors (obs/health.py)
         "serve",  # serve metrics snapshots (serve/metrics.py)
         "serve_reload",  # hot-reload audit records (serve/server.py)
+        "serve_quant",  # quantized-deploy audit: mode + resident bytes (serve/server.py)
         "profile",  # on-demand profiler reports (obs/profiling.py)
         "preempt",  # graceful-preemption record (train/trainer.py)
         "supervisor_attempt",  # resilience.jsonl (resilience/supervisor.py)

@@ -19,6 +19,11 @@ HBM-traffic reducer; within an ICI slice plain fp32/bf16 psum usually wins.
 The fake-quantize form (encode→decode locally) is used inside the jitted
 train step to make training *semantics* identical whether or not the wire is
 actually compressed.
+
+Serving reuses the same lattice (``quantize_with_scale`` + ``safe_divisor``)
+for weight quantization with PER-LEAF scales at levels=127 — static tensors
+quantized once per restore instead of per step (``serve/quantized.py``;
+docs/QUANTIZATION.md "Serving-side weight quantization").
 """
 
 from __future__ import annotations

@@ -7,10 +7,19 @@ socket cluster; this package is the inference counterpart of that ambition
 - :mod:`engine`   — checkpoint restore, shape-bucketed jitted forward cache,
                     the overlap-blended sliding-window tiler (hoisted out of
                     ``predict.py``), and lock-guarded checkpoint hot-reload.
+- :mod:`quantized` — int8/bf16 weight-quantized inference state: per-leaf
+                    max-abs scales computed once per restore/reload,
+                    dequant fused into the jitted forward (host-tier:
+                    its jax imports are function-local, paid only when a
+                    quantize path actually runs).
 - :mod:`batching` — bounded admission queue + dynamic micro-batcher:
                     coalesce up to ``max_batch`` requests or ``max_wait_ms``,
                     whichever first; per-request deadlines; typed
                     ``Overloaded`` load-shedding; graceful drain.
+- :mod:`cbatch`   — continuous batching: ``slots`` workers refill the
+                    device pipeline the moment they free (no coalescing
+                    timer), with interactive/batch priority classes and a
+                    starvation bound.
 - :mod:`metrics`  — latency quantiles (p50/p95/p99), queue depth, batch
                     occupancy, tiles/sec — emitted on the same JSONL stream
                     shape as ``train/observability.py``.
@@ -31,6 +40,7 @@ from ddlpc_tpu.serve.batching import (  # noqa: F401
     MicroBatcher,
     Overloaded,
 )
+from ddlpc_tpu.serve.cbatch import ContinuousBatcher  # noqa: F401
 from ddlpc_tpu.serve.engine import (  # noqa: F401
     InferenceEngine,
     sliding_window_logits,

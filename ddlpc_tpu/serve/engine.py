@@ -26,6 +26,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ddlpc_tpu.resilience import chaos as _chaos_mod
+from ddlpc_tpu.serve import quantized as _quantized
 
 PyTree = object
 
@@ -186,6 +187,8 @@ class InferenceEngine:
         channels: int,
         workdir: Optional[str] = None,
         max_bucket: int = 8,
+        quantize: str = "off",
+        quantize_activations: bool = False,
     ):
         self.cfg = cfg
         self.model = model
@@ -198,6 +201,15 @@ class InferenceEngine:
         self.last_restore_s: Optional[float] = None
         self._lock = threading.Lock()
         self._state = state
+        # Weight quantization (serve/quantized.py): int8/bf16 params with
+        # per-leaf max-abs scales, computed ONCE here (and per reload) —
+        # forwards carry the quantized tree, dequant fused into the jitted
+        # program; the fp32 restore target stays host-side.
+        self.quantize_mode = _quantized.check_mode(quantize)
+        self.quantize_activations = bool(quantize_activations)
+        self._qstate = None
+        if quantize != "off":
+            self._qstate = self._quantize(state)
         # (batch_bucket, th, tw, c) -> jitted logits fn.  Each key owns its
         # own jax.jit wrapper; len(cache) is the number of live executables.
         self._jit_cache: Dict[Tuple[int, int, int, int], Callable] = {}
@@ -208,11 +220,26 @@ class InferenceEngine:
         self._cache_hits = None
         self._cache_misses = None
 
+    def _quantize(self, state):
+        """Quantize ``state`` for serving (off-lock; callers swap the
+        result in under the lock).  Scales are recomputed here and ONLY
+        here — once per restore/reload, never per request."""
+        return _quantized.quantize_state(state, self.quantize_mode)
+
+    def hbm_bytes(self) -> Dict[str, int]:
+        """Resident inference-state bytes by kind, for the SERVING tree
+        the forwards actually carry (the quantized one when quantization
+        is on) — what ``ddlpc_hbm_bytes{kind}`` reports on /metrics."""
+        with self._lock:
+            tree = self._qstate if self._qstate is not None else self._state
+        return _quantized.state_nbytes(tree)
+
     def attach_registry(self, registry) -> None:
         """Publish ``ddlpc_serve_jit_cache_{hits,misses}_total{bucket}``
-        counters into a MetricsRegistry (obs/registry.py) — wired by the
-        ServingFrontend so the shape-bucketed cache's behavior is visible
-        on the existing content-negotiated ``/metrics``."""
+        counters and the ``ddlpc_hbm_bytes{kind}`` gauges into a
+        MetricsRegistry (obs/registry.py) — wired by the ServingFrontend
+        so the shape-bucketed cache's behavior AND the quantized rollout's
+        HBM footprint are visible on the content-negotiated ``/metrics``."""
         self._cache_hits = registry.counter(
             "ddlpc_serve_jit_cache_hits_total",
             "forward_windows calls served by an existing executable, by "
@@ -225,12 +252,31 @@ class InferenceEngine:
             "(compile on first execution), by batch bucket.",
             labelnames=("bucket",),
         )
+        self._hbm_gauge = registry.gauge(
+            "ddlpc_hbm_bytes",
+            "Resident inference-state bytes (the quantized tree when "
+            "weight quantization is on), by kind.",
+            labelnames=("kind",),
+        )
+        self._publish_hbm()
+
+    def _publish_hbm(self) -> None:
+        gauge = getattr(self, "_hbm_gauge", None)
+        if gauge is None:
+            return
+        for kind, nbytes in self.hbm_bytes().items():
+            gauge.set(float(nbytes), kind=kind)
 
     # ---- construction ------------------------------------------------------
 
     @classmethod
     def from_workdir(
-        cls, workdir: str, max_bucket: int = 8, echo: bool = True
+        cls,
+        workdir: str,
+        max_bucket: int = 8,
+        echo: bool = True,
+        quantize: str = "off",
+        quantize_activations: bool = False,
     ) -> "InferenceEngine":
         """Restore a training run's newest checkpoint into an engine.
 
@@ -279,7 +325,8 @@ class InferenceEngine:
                 f"restored step {meta.get('step')} (epoch {meta.get('epoch')})"
             )
         eng = cls(cfg, model, state, channels, workdir=workdir,
-                  max_bucket=max_bucket)
+                  max_bucket=max_bucket, quantize=quantize,
+                  quantize_activations=quantize_activations)
         eng.checkpoint_step = meta.get("step")
         return eng
 
@@ -319,6 +366,13 @@ class InferenceEngine:
             monkey.on_serve_reload(ckpt_dir)
         t0 = _time.perf_counter()
         state, meta = ckpt.restore_checkpoint(ckpt_dir, self.state, step=step)
+        # Re-quantize BEFORE the swap (still off-lock): scales are
+        # per-checkpoint data, and in-flight forwards must never see new
+        # fp32 state paired with old int8 weights — the lock below swaps
+        # (state, qstate) as one unit.
+        qstate = (
+            self._quantize(state) if self.quantize_mode != "off" else None
+        )
         restore_s = _time.perf_counter() - t0
         resolved = meta.get("step") if meta.get("step") is not None else step
         fmt = None
@@ -329,10 +383,14 @@ class InferenceEngine:
                 pass  # pruned between restore and stat — timing still valid
         with self._lock:
             self._state = state
+            self._qstate = qstate
             self.version += 1
             self.checkpoint_step = meta.get("step")
             self.last_restore_s = restore_s
+        self._publish_hbm()
         meta = dict(meta, restore_seconds=round(restore_s, 4))
+        if self.quantize_mode != "off":
+            meta["quantize"] = self.quantize_mode
         if fmt is not None:
             meta["restore_format"] = fmt
         return meta
@@ -344,9 +402,17 @@ class InferenceEngine:
             fn = self._jit_cache.get(key)
             hit = fn is not None
             if fn is None:
-                from ddlpc_tpu.parallel.train_step import make_logits_fn
+                if self.quantize_mode != "off":
+                    fn = _quantized.make_quantized_logits_fn(
+                        self.model,
+                        self.quantize_mode,
+                        quantize_activations=self.quantize_activations,
+                    )
+                else:
+                    from ddlpc_tpu.parallel.train_step import make_logits_fn
 
-                fn = self._jit_cache[key] = make_logits_fn(self.model)
+                    fn = make_logits_fn(self.model)
+                self._jit_cache[key] = fn
         counter = self._cache_hits if hit else self._cache_misses
         if counter is not None:
             counter.inc(bucket=str(key[0]))
@@ -377,7 +443,12 @@ class InferenceEngine:
             # path — batcher fails the batch, frontend answers 500, the
             # fleet router's breaker counts it.  Inert when unset.
             monkey.on_serve_forward()
-        state = self.state  # one snapshot: never mixes reload versions
+        # One snapshot: never mixes reload versions (quantized forwards
+        # carry the quantized tree; the fp32 state is the restore target).
+        with self._lock:
+            state = (
+                self._qstate if self._qstate is not None else self._state
+            )
         outs = []
         for i in range(0, n, self.max_bucket):
             chunk = windows[i : i + self.max_bucket]

@@ -37,6 +37,13 @@ class ServeMetrics:
     def __init__(self, window: int = 2048, registry=None):
         self._lock = threading.Lock()
         self._lat = deque(maxlen=window)  # seconds, most-recent window
+        # Per-priority-class latency rings (serve/cbatch.py): bulk tiling
+        # work must be visible as ITS OWN tail, not a contaminant of the
+        # interactive p99 the fleet router protects.
+        self._lat_by_prio = {
+            "interactive": deque(maxlen=window),
+            "batch": deque(maxlen=window),
+        }
         # Windowed like the latency ring: a day-old cold-start ramp must
         # not drag the reported occupancy permanently (the old lifetime
         # `_occupancy_sum` did exactly that).
@@ -44,9 +51,16 @@ class ServeMetrics:
         self.requests = 0
         self.tiles = 0
         self.shed = 0
+        self.shed_batch = 0  # bulk-class admissions shed (subset of shed)
         self.deadline_exceeded = 0
         self.batches = 0
         self.queue_depth = 0
+        self.priority_depths = {"interactive": 0, "batch": 0}
+        # False until a priority-aware batcher reports per-class depths;
+        # snapshot() then mirrors the single queue into interactive so a
+        # coalesce-mode stream never contradicts itself (queue_depth=40,
+        # queue_depth_interactive=0).
+        self._prio_source = False
         self._t0 = time.monotonic()
         self._last_t = self._t0
         self._last_requests = 0
@@ -82,13 +96,24 @@ class ServeMetrics:
                 "queue_depth": registry.gauge(
                     "ddlpc_serve_queue_depth", "Admission queue depth (tiles)."
                 ),
+                "priority_depth": registry.gauge(
+                    "ddlpc_serve_priority_queue_depth",
+                    "Admission queue depth by priority class "
+                    "(continuous batcher).",
+                    labelnames=("priority",),
+                ),
             }
 
     # ---- recording hooks ---------------------------------------------------
 
-    def record_request(self, latency_s: float, tiles: int = 1) -> None:
+    def record_request(
+        self, latency_s: float, tiles: int = 1, priority: str = "interactive"
+    ) -> None:
         with self._lock:
             self._lat.append(float(latency_s))
+            ring = self._lat_by_prio.get(priority)
+            if ring is not None:
+                ring.append(float(latency_s))
             self.requests += 1
             self.tiles += int(tiles)
         if self._reg is not None:
@@ -105,9 +130,11 @@ class ServeMetrics:
             self._reg["batches"].inc()
             self._reg["occupancy"].set(occ)
 
-    def record_shed(self, n: int = 1) -> None:
+    def record_shed(self, n: int = 1, priority: str = "interactive") -> None:
         with self._lock:
             self.shed += int(n)
+            if priority == "batch":
+                self.shed_batch += int(n)
         if self._reg is not None:
             self._reg["shed"].inc(int(n))
 
@@ -122,6 +149,21 @@ class ServeMetrics:
             self.queue_depth = int(depth)
         if self._reg is not None:
             self._reg["queue_depth"].set(int(depth))
+
+    def set_priority_queue_depth(self, depths: Dict[str, int]) -> None:
+        """Per-priority-class depths (continuous batcher hook)."""
+        with self._lock:
+            self._prio_source = True
+            self.priority_depths.update(
+                {p: int(d) for p, d in depths.items()}
+            )
+        if self._reg is not None:
+            for p, d in depths.items():
+                self._reg["priority_depth"].set(int(d), priority=p)
+
+    def priority_queue_depths(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.priority_depths)
 
     # ---- readout -----------------------------------------------------------
 
@@ -165,18 +207,32 @@ class ServeMetrics:
                 self._last_requests = self.requests
                 self._last_tiles = self.tiles
             occupancy = float(np.mean(self._occ)) if self._occ else None
+            by_prio = {}
+            for p, ring in self._lat_by_prio.items():
+                if ring:
+                    by_prio[f"{p}_p99_ms"] = round(
+                        float(np.percentile(np.asarray(ring) * 1e3, 99)), 3
+                    )
             return {
                 "kind": "serve",
                 **pct,
+                **by_prio,
                 "requests": self.requests,
                 "tiles": self.tiles,
                 "shed": self.shed,
+                "shed_batch": self.shed_batch,
                 "deadline_exceeded": self.deadline_exceeded,
                 "batches": self.batches,
                 "batch_occupancy": (
                     round(occupancy, 4) if occupancy is not None else None
                 ),
                 "queue_depth": self.queue_depth,
+                "queue_depth_interactive": (
+                    self.priority_depths["interactive"]
+                    if self._prio_source
+                    else self.queue_depth
+                ),
+                "queue_depth_batch": self.priority_depths["batch"],
                 "requests_per_sec": round(req_rate, 3),
                 "tiles_per_sec": round(tile_rate, 3),
                 "uptime_s": round(now - self._t0, 3),

@@ -1,0 +1,192 @@
+"""Weight-quantized inference path: int8 / bf16 params, fp32 math.
+
+The training side already owns a quantization codec (ops/quantize.py) —
+for *gradients*, with one whole-model scale because that is what the
+reference put on the wire.  Serving weights want the transposed trade:
+the tensors are static between reloads, so the scales can be computed
+ONCE per restore (not per step), and per-leaf max-abs scales cost nothing
+while being dramatically tighter than a global one (a conv kernel's
+absmax and a BN bias's absmax differ by orders of magnitude — one shared
+scale would flush the small leaves to a handful of lattice points).
+
+Scheme, per float param leaf:
+
+- ``int8``: ``scale = absmax(leaf) / 127`` (zero-guarded by
+  ops/quantize.safe_divisor), ``q = clip(round(leaf / scale), ±127)``
+  via ops/quantize.quantize_with_scale — the training codec's one
+  lattice formula with per-leaf scales and levels=127 — stored as int8:
+  4× smaller than fp32 in HBM, worst-case per-weight error
+  ``absmax/254``;
+- ``bf16``: round-to-nearest-even cast — 2× smaller, ~3 decimal digits;
+- ``off``: identity (the engine never calls in here).
+
+Dequantization is FUSED INTO THE JITTED FORWARD: the compiled program
+takes the quantized tree, multiplies each leaf back to fp32 (one
+elementwise op XLA fuses into the first consumer), and runs the model
+unchanged — so the *resident* weights are int8/bf16 while the math keeps
+the model's own compute dtype.  Only the quantized tree lives on device;
+the fp32 restore target stays host-side between reloads.
+
+Activation quantization (``quantize_activations``) casts the input
+windows to bf16 inside the same jitted program — a knob, default off,
+enabled only where the hard-task table says quality holds
+(docs/SERVING.md "Continuous batching & quantized inference").
+
+Batch-norm statistics are never quantized: they are a rounding error of
+the params' footprint and their scale structure (running variances) is
+exactly what coarse lattices destroy.
+
+Tier note (analysis/tiers.py): this module is ``host``-tier — the engine
+imports it eagerly, and the module's own jax imports are function-local
+(paid only when a quantize/dequant path actually runs, the
+obs/profiling idiom), so router/fleet stay provably jax-free.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+PyTree = Any
+
+MODES = ("off", "int8", "bf16")
+
+
+class QuantizedState(NamedTuple):
+    """Device-resident quantized inference state (a pytree: NamedTuples
+    of arrays jit cleanly).  ``scales`` carries one fp32 scalar per param
+    leaf for int8 (all-ones placeholders for bf16, so the treedef is
+    mode-independent)."""
+
+    params: PyTree  # int8 or bf16 leaves, same structure as fp32 params
+    scales: PyTree  # fp32 scalar per leaf
+    batch_stats: PyTree  # fp32, untouched
+
+
+def check_mode(mode: str) -> str:
+    if mode not in MODES:
+        raise ValueError(
+            f"unknown weight-quantization mode {mode!r} "
+            f"(expected one of {MODES})"
+        )
+    return mode
+
+
+def quantize_error_bound(mode: str) -> float:
+    """Worst-case per-weight |dequant - original| as a fraction of the
+    leaf's absmax: half an int8 lattice step, or bf16's 8-bit mantissa
+    rounding.  The parity tests derive their tolerances from this."""
+    check_mode(mode)
+    if mode == "int8":
+        return 0.5 / 127.0
+    if mode == "bf16":
+        return 2.0 ** -8  # relative rounding of a bf16 cast
+    return 0.0
+
+
+def quantize_state(state, mode: str) -> QuantizedState:
+    """Quantize a restored TrainState's params for serving.
+
+    Runs eagerly, ONCE per restore/reload — scales are data-dependent on
+    the checkpoint, not on traffic.  Leaves arrive as whatever the
+    checkpoint reader produced (host numpy); the returned tree is
+    device-committed so forwards never re-upload.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ddlpc_tpu.ops.quantize import quantize_with_scale, safe_divisor
+
+    check_mode(mode)
+    if mode == "off":
+        raise ValueError("quantize_state needs mode 'int8' or 'bf16'")
+
+    def q_leaf(p):
+        p32 = jnp.asarray(p, jnp.float32)
+        if mode == "bf16":
+            return p32.astype(jnp.bfloat16), jnp.float32(1.0)
+        # The training codec's lattice formula (snap, clip, zero-guard),
+        # with levels=127 and one scale PER LEAF instead of one per
+        # model — the serving transpose described in the module docstring.
+        safe = safe_divisor(jnp.max(jnp.abs(p32)))
+        q = quantize_with_scale(p32, safe, 127.0).astype(jnp.int8)
+        return q, safe / 127.0
+
+    pairs = jax.tree.map(q_leaf, state.params)
+    params = jax.tree.map(lambda pr: pr[0], pairs, is_leaf=lambda x: isinstance(x, tuple))
+    scales = jax.tree.map(lambda pr: pr[1], pairs, is_leaf=lambda x: isinstance(x, tuple))
+    batch_stats = jax.tree.map(
+        lambda b: jnp.asarray(b, jnp.float32), state.batch_stats
+    )
+    out = QuantizedState(params, scales, batch_stats)
+    return jax.tree.map(jax.device_put, out)
+
+
+def dequantize_params(params: PyTree, scales: PyTree, mode: str) -> PyTree:
+    """fp32 params from the quantized tree — jittable; inside the
+    compiled forward this is one fused multiply per leaf (the
+    ops/quantize.decode runtime-scalar idiom, so dequantization is
+    bit-identical across every bucket's program)."""
+    import jax
+    import jax.numpy as jnp
+
+    if mode == "bf16":
+        return jax.tree.map(lambda q: q.astype(jnp.float32), params)
+    return jax.tree.map(
+        lambda q, s: q.astype(jnp.float32) * s, params, scales
+    )
+
+
+def make_quantized_logits_fn(model, mode: str, quantize_activations: bool = False):
+    """Jitted ``fn(qstate, images) -> logits`` with dequant fused in.
+
+    The counterpart of train_step.make_logits_fn for a quantized engine:
+    same output contract (raw logits [N, H, W, C]), different resident
+    state.  One wrapper per (bucket, geometry) key, exactly like the
+    fp32 path — the engine's jit cache does not care which it holds.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    check_mode(mode)
+
+    @jax.jit
+    def logits_fn(qstate: QuantizedState, images: jax.Array) -> jax.Array:
+        params = dequantize_params(qstate.params, qstate.scales, mode)
+        if quantize_activations:
+            images = images.astype(jnp.bfloat16)
+        return model.apply(
+            {"params": params, "batch_stats": qstate.batch_stats},
+            images,
+            train=False,
+        )
+
+    return logits_fn
+
+
+def tree_nbytes(tree: PyTree) -> int:
+    """Total resident bytes of a pytree of arrays (shape × itemsize —
+    the obs/hbm.py accounting for the unsharded serving case)."""
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        total += int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+    return total
+
+
+def state_nbytes(state_or_q) -> dict:
+    """``{params: bytes, batch_stats: bytes}`` for either a TrainState or
+    a QuantizedState — what the engine publishes on
+    ``ddlpc_hbm_bytes{kind}`` so a quantized rollout's HBM saving is a
+    scrape, not a claim."""
+    if isinstance(state_or_q, QuantizedState):
+        return {
+            "params": tree_nbytes(state_or_q.params)
+            + tree_nbytes(state_or_q.scales),
+            "batch_stats": tree_nbytes(state_or_q.batch_stats),
+        }
+    return {
+        "params": tree_nbytes(state_or_q.params),
+        "batch_stats": tree_nbytes(state_or_q.batch_stats),
+    }

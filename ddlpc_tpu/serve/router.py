@@ -47,6 +47,7 @@ import threading
 import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from urllib.parse import parse_qs
 
 from ddlpc_tpu.analysis import lockcheck
 from ddlpc_tpu.config import FleetConfig
@@ -62,6 +63,16 @@ class ReplicaError(RuntimeError):
 
 class NoReplicasAvailable(RuntimeError):
     """No eligible replica (all dead, draining, or breaker-open)."""
+
+
+def _priority_of(query: str) -> str:
+    """Priority class of a request from its query string.  Unknown values
+    fall back to interactive for ROUTING policy only — the replica's
+    frontend still 400s them, so a typo cannot silently become bulk."""
+    if not query:
+        return "interactive"
+    p = parse_qs(query).get("priority", ["interactive"])[0]
+    return p if p == "batch" else "interactive"
 
 
 def _percentile(sorted_vals: Sequence[float], q: float) -> Optional[float]:
@@ -334,6 +345,7 @@ class RouterMetrics:
         self.retries = 0
         self.hedges = 0
         self.hedge_wins = 0
+        self.batch_shed = 0  # bulk-class requests shed at the router
         self.breaker_opens = 0
         self.breaker_half_opens = 0
         self.breaker_closes = 0
@@ -369,6 +381,12 @@ class RouterMetrics:
                 "hedge_wins": registry.counter(
                     "ddlpc_router_hedge_wins_total",
                     "Requests answered by the hedged attempt.",
+                ),
+                "batch_shed": registry.counter(
+                    "ddlpc_router_batch_shed_total",
+                    "Bulk-class (?priority=batch) requests shed at the "
+                    "router because every eligible replica's interactive "
+                    "queue was at or above batch_shed_queue_depth.",
                 ),
                 "breaker": registry.counter(
                     "ddlpc_router_breaker_transitions_total",
@@ -428,6 +446,12 @@ class RouterMetrics:
         if self._reg is not None:
             self._reg["hedge_wins"].inc()
 
+    def record_batch_shed(self) -> None:
+        with self._lock:
+            self.batch_shed += 1
+        if self._reg is not None:
+            self._reg["batch_shed"].inc()
+
     def record_breaker(self, replica: str, to: str) -> None:
         with self._lock:
             if to == "open":
@@ -479,6 +503,7 @@ class RouterMetrics:
                 "retries": self.retries,
                 "hedges": self.hedges,
                 "hedge_wins": self.hedge_wins,
+                "batch_shed": self.batch_shed,
                 "breaker_opens": self.breaker_opens,
                 "breaker_half_opens": self.breaker_half_opens,
                 "breaker_closes": self.breaker_closes,
@@ -516,6 +541,13 @@ class _Replica:
         self.healthy = True  # scrape-declared (flips after N failed scrapes)
         self.inflight = 0  # router-side attempts outstanding
         self.queue_depth = 0  # scraped
+        # Per-priority depths + quant mode (scraped from the same one
+        # /healthz): what priority-aware dispatch/shedding and quantized
+        # rolling reloads rank on.  Replicas predating the continuous
+        # batcher report only the total; interactive then mirrors it.
+        self.queue_depth_interactive = 0  # scraped
+        self.queue_depth_batch = 0  # scraped
+        self.quant_mode: Optional[str] = None  # scraped
         self.occupancy: Optional[float] = None  # scraped
         self.checkpoint_step: Optional[int] = None  # scraped
         self.version: Optional[int] = None  # scraped
@@ -530,6 +562,9 @@ class _Replica:
             "breaker": self.breaker.state,
             "inflight": self.inflight,
             "queue_depth": self.queue_depth,
+            "queue_depth_interactive": self.queue_depth_interactive,
+            "queue_depth_batch": self.queue_depth_batch,
+            "quant_mode": self.quant_mode,
             "occupancy": self.occupancy,
             "checkpoint_step": self.checkpoint_step,
             "version": self.version,
@@ -674,6 +709,12 @@ class FleetRouter:
                 r.scrape_fail_streak = 0
                 r.healthy = True
                 r.queue_depth = int(h.get("queue_depth") or 0)
+                r.queue_depth_interactive = int(
+                    h.get("queue_depth_interactive", h.get("queue_depth"))
+                    or 0
+                )
+                r.queue_depth_batch = int(h.get("queue_depth_batch") or 0)
+                r.quant_mode = h.get("quant_mode")
                 occ = h.get("batch_occupancy")
                 r.occupancy = float(occ) if occ is not None else None
                 r.checkpoint_step = h.get("checkpoint_step")
@@ -839,6 +880,26 @@ class FleetRouter:
             a.replica.inflight = max(0, a.replica.inflight - 1)
             self._drain_cond.notify_all()
 
+    def _launch_waiting(
+        self, body: bytes, query: str, reason: str,
+        exclude: Sequence[str], done: "queue.Queue[_Attempt]",
+    ) -> Optional["_Attempt"]:
+        """`_launch` plus the bounded zero-eligible wait: a rolling
+        reload's drain→readmit hand-off, a relaunch-readiness gap, and a
+        breaker cooldown can momentarily leave NO eligible replica — a
+        transient total-outage blip that should surface as tail latency,
+        not a client-visible 503.  Admission and the no-pending retry
+        pick ride it out the same way (per-pick bound)."""
+        a = self._launch(body, query, reason, exclude, done)
+        if a is None and self.cfg.no_replica_wait_ms > 0:
+            deadline = (
+                time.monotonic() + self.cfg.no_replica_wait_ms / 1000.0
+            )
+            while a is None and time.monotonic() < deadline:
+                self._sleep(self._rng.uniform(0.01, 0.04))
+                a = self._launch(body, query, reason, exclude, done)
+        return a
+
     def _launch(
         self, body: bytes, query: str, reason: str,
         exclude: Sequence[str], done: "queue.Queue[_Attempt]",
@@ -885,12 +946,46 @@ class FleetRouter:
                 except Exception:
                     pass
 
+    def _should_shed_batch(self) -> bool:
+        """Bulk shedding rule: with ``batch_shed_queue_depth`` armed,
+        ?priority=batch requests are shed when EVERY eligible replica's
+        scraped interactive queue is at or past the threshold — bulk work
+        must never consume the last admission the interactive tail needs.
+        Interactive traffic is never shed by this rule."""
+        threshold = int(self.cfg.batch_shed_queue_depth)
+        if threshold <= 0:
+            return False
+        with self._lock:
+            eligible = [
+                r
+                for r in self._replicas.values()
+                if r.ready and not r.draining and r.healthy
+                and r.breaker.available()
+            ]
+            if not eligible:
+                return False  # the normal no-replica path answers this
+            return all(
+                r.queue_depth_interactive >= threshold for r in eligible
+            )
+
     def dispatch(self, body: bytes, query: str = "") -> Response:
         """Route one request; ALWAYS returns a response.  A 5xx here means
         every eligible replica (and every retry/hedge) failed — the
-        client-visible failure the fleet soak requires to be zero."""
+        client-visible failure the fleet soak requires to be zero.
+        ``?priority=batch`` requests may additionally be SHED here (a
+        policy 503, accounted separately from failures) when the fleet's
+        interactive queues are saturated, and are never hedged — hedges
+        are a p99-tail spend reserved for interactive traffic."""
+        priority = _priority_of(query)
+        if priority == "batch" and self._should_shed_batch():
+            self.metrics.record_batch_shed()
+            self._log_event("batch_shed")
+            return self._error(
+                503, "bulk traffic shed: interactive queues saturated; "
+                "retry with backoff"
+            )
         t0 = time.monotonic()
-        status, ctype, payload = self._dispatch_inner(body, query)
+        status, ctype, payload = self._dispatch_inner(body, query, priority)
         ok = status < 500
         self.metrics.record_request(time.monotonic() - t0, ok)
         return status, ctype, payload
@@ -898,15 +993,21 @@ class FleetRouter:
     def _error(self, status: int, msg: str) -> Response:
         return status, "application/json", json.dumps({"error": msg}).encode()
 
-    def _dispatch_inner(self, body: bytes, query: str) -> Response:
+    def _dispatch_inner(
+        self, body: bytes, query: str, priority: str = "interactive"
+    ) -> Response:
         cfg = self.cfg
         done: "queue.Queue[_Attempt]" = queue.Queue()
         attempts: List[_Attempt] = []
         tried: List[str] = []
         retries_left = max(0, int(cfg.retries))
-        hedges_left = max(0, int(cfg.hedge_max)) if cfg.hedge_ms > 0 else 0
+        hedges_left = (
+            max(0, int(cfg.hedge_max))
+            if cfg.hedge_ms > 0 and priority == "interactive"
+            else 0
+        )
 
-        a = self._launch(body, query, "primary", tried, done)
+        a = self._launch_waiting(body, query, "primary", tried, done)
         if a is None:
             self._log_event("no_replicas")
             return self._error(503, "no replicas available")
@@ -968,6 +1069,14 @@ class FleetRouter:
                 if delay > 0:
                     self._sleep(delay)
                 nxt = self._launch(body, query, "retry", tried, done)
+                if nxt is None and pending == 0:
+                    # With nothing pending this would fall through to an
+                    # instant 503 — the same transient zero-eligible
+                    # window the admission wait rides out (an untried
+                    # replica readmitting mid-reload); wait for it too.
+                    nxt = self._launch_waiting(
+                        body, query, "retry", tried, done
+                    )
                 if nxt is not None:
                     attempts.append(nxt)
                     tried.append(nxt.replica.name)

@@ -47,6 +47,7 @@ from ddlpc_tpu.serve.batching import (
     MicroBatcher,
     Overloaded,
 )
+from ddlpc_tpu.serve.cbatch import ContinuousBatcher, check_priority
 from ddlpc_tpu.serve.engine import (
     InferenceEngine,
     Stitcher,
@@ -85,14 +86,35 @@ class ServingFrontend:
         attach = getattr(engine, "attach_registry", None)
         if attach is not None:
             attach(self.registry)
-        self.batcher = MicroBatcher(
-            engine.forward_windows,
-            max_batch=self.cfg.max_batch,
-            max_wait_ms=self.cfg.max_wait_ms,
-            queue_limit=self.cfg.queue_limit,
-            metrics=self.metrics,
-            tracer=self.tracer,
-        )
+        # Admission loop: 'continuous' (serve/cbatch.py — slot-based
+        # refill, priority classes) or PR 1's coalesce-and-wait
+        # MicroBatcher.  Both expose the same submit/drain/typed-error
+        # surface; everything below is batcher-agnostic.
+        if self.cfg.batcher == "continuous":
+            self.batcher = ContinuousBatcher(
+                engine.forward_windows,
+                max_batch=self.cfg.max_batch,
+                queue_limit=self.cfg.queue_limit,
+                batch_queue_limit=self.cfg.batch_queue_limit,
+                slots=self.cfg.slots,
+                starvation_every=self.cfg.starvation_every,
+                metrics=self.metrics,
+                tracer=self.tracer,
+            )
+        elif self.cfg.batcher == "coalesce":
+            self.batcher = MicroBatcher(
+                engine.forward_windows,
+                max_batch=self.cfg.max_batch,
+                max_wait_ms=self.cfg.max_wait_ms,
+                queue_limit=self.cfg.queue_limit,
+                metrics=self.metrics,
+                tracer=self.tracer,
+            )
+        else:
+            raise ValueError(
+                f"unknown batcher {self.cfg.batcher!r} "
+                f"(expected 'continuous' or 'coalesce')"
+            )
         self.logger = logger
         if logger is not None and getattr(logger, "registry", None) is None:
             # The serve CLI builds its logger before this frontend (and its
@@ -116,6 +138,9 @@ class ServingFrontend:
         self.last_reload_error: Optional[str] = None
         self._profile_lock = threading.Lock()
         self._profile_n = 0
+        # Quantized deploys leave an audit record of what is resident:
+        # mode + actual byte footprint, once at start and per reload.
+        self._log_quant()
         self._emit_stop = threading.Event()
         self._emitter: Optional[threading.Thread] = None
         if logger is not None and self.cfg.metrics_every_s > 0:
@@ -123,6 +148,25 @@ class ServingFrontend:
                 target=self._emit_loop, name="serve-metrics", daemon=True
             )
             self._emitter.start()
+
+    def _log_quant(self) -> None:
+        """kind="serve_quant" audit record: which weight-quant mode is
+        live and what the resident inference state actually weighs."""
+        mode = getattr(self.engine, "quantize_mode", "off")
+        if self.logger is None or mode == "off":
+            return
+        rec = {
+            "kind": "serve_quant",
+            "mode": mode,
+            "quantize_activations": bool(
+                getattr(self.engine, "quantize_activations", False)
+            ),
+            "checkpoint_step": self.engine.checkpoint_step,
+        }
+        hbm = getattr(self.engine, "hbm_bytes", None)
+        if hbm is not None:
+            rec.update({f"{k}_bytes": int(v) for k, v in hbm().items()})
+        self.logger.log(rec, echo=False)
 
     def _emit_loop(self) -> None:
         while not self._emit_stop.wait(self.cfg.metrics_every_s):
@@ -137,11 +181,18 @@ class ServingFrontend:
     # ---- request paths -----------------------------------------------------
 
     def predict_logits(
-        self, image: np.ndarray, overlap: Optional[float] = None
+        self,
+        image: np.ndarray,
+        overlap: Optional[float] = None,
+        priority: str = "interactive",
     ) -> np.ndarray:
         """Full-scene logits with every window routed through the batcher —
-        windows from concurrent scenes coalesce into shared forwards."""
+        windows from concurrent scenes coalesce into shared forwards.
+        ``priority='batch'`` files the scene's windows into the bulk
+        admission queue (continuous batcher; the coalesce batcher has one
+        queue and the class is accounting-only)."""
         image = np.asarray(image, np.float32)
+        check_priority(priority)
         if image.ndim != 3:
             raise ValueError(f"expected [H, W, C] image, got {image.shape}")
         if image.shape[-1] != self.engine.channels:
@@ -157,12 +208,15 @@ class ServingFrontend:
         # cross-thread and stand alone on the worker's track).
         with self.tracer.span("serve_request") as req_span:
             out, n_tiles = self._predict_logits_inner(
-                image, overlap, th, tw, req_span
+                image, overlap, th, tw, req_span, priority
             )
-        self.metrics.record_request(time.monotonic() - t0, tiles=n_tiles)
+        self.metrics.record_request(
+            time.monotonic() - t0, tiles=n_tiles, priority=priority
+        )
         return out
 
-    def _predict_logits_inner(self, image, overlap, th, tw, req_span):
+    def _predict_logits_inner(self, image, overlap, th, tw, req_span,
+                              priority="interactive"):
         with self.tracer.span("window_plan"):
             padded, origins, (h, w) = window_plan(
                 image, self.engine.tile, overlap
@@ -182,12 +236,18 @@ class ServingFrontend:
             if self.cfg.deadline_ms
             else None
         )
+        submit_kwargs = (
+            {"priority": priority}
+            if isinstance(self.batcher, ContinuousBatcher)
+            else {}
+        )
         for i in range(0, len(origins), chunk_size):
             chunk = origins[i : i + chunk_size]
             windows = [padded[y : y + th, x : x + tw] for y, x in chunk]
             with self.tracer.span("enqueue", windows=len(windows)):
                 futures = self.batcher.submit_many(
-                    windows, deadline_ms=self.cfg.deadline_ms or None
+                    windows, deadline_ms=self.cfg.deadline_ms or None,
+                    **submit_kwargs,
                 )
             try:
                 with self.tracer.span("stitch", windows=len(windows)):
@@ -205,11 +265,14 @@ class ServingFrontend:
         return out, len(origins)
 
     def predict_classes(
-        self, image: np.ndarray, overlap: Optional[float] = None
+        self,
+        image: np.ndarray,
+        overlap: Optional[float] = None,
+        priority: str = "interactive",
     ) -> np.ndarray:
-        return np.argmax(self.predict_logits(image, overlap), axis=-1).astype(
-            np.int32
-        )
+        return np.argmax(
+            self.predict_logits(image, overlap, priority=priority), axis=-1
+        ).astype(np.int32)
 
     def reload(self, workdir: Optional[str] = None, step=None) -> dict:
         """Hot-reload; NEVER raises (ISSUE 7 satellite).
@@ -276,20 +339,35 @@ class ServingFrontend:
                 },
                 echo=False,
             )
+        self._log_quant()  # fresh scales/footprint after the swap
         return meta
 
     def healthz(self) -> dict:
         # Queue depth, limit, and windowed batch occupancy ride along so
         # the fleet router's occupancy-aware dispatch has ONE cheap scrape
-        # endpoint instead of parsing the full /metrics exposition.
+        # endpoint instead of parsing the full /metrics exposition; the
+        # per-priority depths and quant mode keep that one-scrape contract
+        # sufficient for priority-aware dispatch and quantized rollouts.
+        depths_fn = getattr(self.batcher, "queue_depths", None)
+        depths = (
+            depths_fn()
+            if depths_fn is not None
+            else {"interactive": self.batcher.queue_depth, "batch": 0}
+        )
         return {
             "status": "draining" if self.draining else "ok",
             "version": self.engine.version,
+            # queue_depth derives from the SAME read as the per-class
+            # depths — one scrape must never contradict itself (the
+            # router ranks on the total and sheds on the classes).
             "checkpoint_step": self.engine.checkpoint_step,
             "tile": list(self.engine.tile),
             "channels": self.engine.channels,
-            "queue_depth": self.batcher.queue_depth,
+            "queue_depth": sum(depths.values()),
+            "queue_depth_interactive": depths.get("interactive", 0),
+            "queue_depth_batch": depths.get("batch", 0),
             "queue_limit": self.cfg.queue_limit,
+            "quant_mode": getattr(self.engine, "quantize_mode", "off"),
             "batch_occupancy": self.metrics.occupancy(),
             "compiled_shapes": self.engine.compiled_shapes,
             "last_reload_error": self.last_reload_error,
@@ -542,7 +620,10 @@ class _Handler(BaseHTTPRequestHandler):
         q = parse_qs(parsed.query)
         try:
             overlap = float(q["overlap"][0]) if "overlap" in q else None
-            pred = self.frontend.predict_classes(image, overlap=overlap)
+            priority = q["priority"][0] if "priority" in q else "interactive"
+            pred = self.frontend.predict_classes(
+                image, overlap=overlap, priority=priority
+            )
         except Overloaded as e:
             self._send_json(503, {"error": str(e)}, extra=[("Retry-After", "1")])
         except (DeadlineExceeded, TimeoutError,
@@ -590,6 +671,10 @@ class _Handler(BaseHTTPRequestHandler):
             "restore_seconds": meta.get("restore_seconds"),
             "restore_format": meta.get("restore_format"),
         }
+        if meta.get("quantize"):
+            # A quantized engine's reload answer says what is now
+            # resident (scales were recomputed from the new checkpoint).
+            resp["quantize"] = meta["quantize"]
         if meta.get("quarantined_steps"):
             # Succeeded via fallback: corrupt newer blob(s) were renamed
             # *.bad and an older checkpoint restored.
@@ -656,7 +741,12 @@ def main(argv=None) -> int:
 
     from ddlpc_tpu.train.observability import MetricsLogger
 
-    engine = InferenceEngine.from_workdir(cfg.workdir, max_bucket=cfg.max_batch)
+    engine = InferenceEngine.from_workdir(
+        cfg.workdir,
+        max_bucket=cfg.max_batch,
+        quantize=cfg.quantize,
+        quantize_activations=cfg.quantize_activations,
+    )
     engine.warmup()  # compile every bucket before declaring ready
     metrics_dir = cfg.metrics_dir or cfg.workdir
     os.makedirs(metrics_dir, exist_ok=True)

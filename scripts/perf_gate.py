@@ -75,6 +75,12 @@ GATED = {
     # (scripts/serve_bench.py --fleet).  Carries subprocess + HTTP + CPU
     # scheduling noise on top of the engine, hence the widest band.
     "fleet_p99_ms": dict(unit="ms", direction="lower", tolerance=0.75),
+    # Per-replica accelerator throughput on the same fleet arm — the
+    # ROADMAP acceptance metric for the continuous-batching/quantized
+    # serving work; gated so it cannot silently regress either.
+    "fleet_tiles_per_s_per_replica": dict(
+        unit="tiles/s", direction="higher", tolerance=0.50
+    ),
 }
 
 
@@ -335,7 +341,11 @@ def arm_loader(rounds: int) -> Dict[str, float]:
 
 
 def arm_serve(rounds: int) -> Dict[str, float]:
-    """serve_p99_ms: the closed-loop serving load on a tiny checkpoint."""
+    """serve_p99_ms: the closed-loop serving load on a tiny checkpoint.
+
+    Best-of-rounds like the other arms: 12 requests make p99 the sample
+    max, and this host's ~25 ms-every-100 ms CPU-steal windows turn a
+    single draw into a dice roll (see arm_fleet)."""
     import tempfile
 
     import serve_bench
@@ -343,17 +353,31 @@ def arm_serve(rounds: int) -> Dict[str, float]:
     with tempfile.TemporaryDirectory() as tmp:
         workdir = os.path.join(tmp, "gate_serve_run")
         serve_bench.make_tiny_run(workdir)
-        rec = serve_bench.run_load(
-            workdir, clients=2, requests=12, scene=40, max_batch=4,
-            max_wait_ms=2.0,
-        )
-    return {"serve_p99_ms": float(rec["value"])}
+        best = None
+        for _ in range(max(rounds, 3)):
+            rec = serve_bench.run_load(
+                workdir, clients=2, requests=12, scene=40, max_batch=4,
+                max_wait_ms=2.0,
+            )
+            if best is None or rec["value"] < best["value"]:
+                best = rec
+    return {"serve_p99_ms": float(best["value"])}
 
 
 def arm_fleet(rounds: int) -> Dict[str, float]:
-    """fleet_p99_ms: routed load over 2 replica subprocesses (the fleet
-    path from ISSUE 10 — retries/hedging/breaker machinery included in
-    what is measured, exactly like production)."""
+    """fleet_p99_ms + fleet_tiles_per_s_per_replica: routed load over 2
+    replica subprocesses (the fleet path from ISSUE 10 — retries/hedging/
+    breaker machinery included in what is measured, exactly like
+    production).
+
+    The load is a STORM — 8 closed-loop clients against 2 replicas — not
+    a trickle: continuous batching (ISSUE 13) is a saturation/ragged-
+    traffic technology, and a 2-client loop never engages the refill path
+    at all.  400 requests so p99 is a real percentile, not the max of two
+    dozen samples; best-of-rounds like the other arms (this host steals
+    ~25 ms of CPU every ~100 ms — one storm landing across fewer steal
+    windows is the reproducible number, and both fleet metrics come from
+    the SAME best-p99 round so the pair stays internally consistent)."""
     import tempfile
 
     import serve_bench
@@ -361,11 +385,20 @@ def arm_fleet(rounds: int) -> Dict[str, float]:
     with tempfile.TemporaryDirectory() as tmp:
         workdir = os.path.join(tmp, "gate_fleet_run")
         serve_bench.make_tiny_run(workdir)
-        rec = serve_bench.run_fleet_load(
-            workdir, replicas=2, clients=2, requests=24, tile=32,
-            max_batch=4, max_wait_ms=2.0,
-        )
-    return {"fleet_p99_ms": float(rec["value"])}
+        best = None
+        for _ in range(max(rounds, 2)):
+            rec = serve_bench.run_fleet_load(
+                workdir, replicas=2, clients=8, requests=400, tile=32,
+                max_batch=4, max_wait_ms=2.0,
+            )
+            if best is None or rec["value"] < best["value"]:
+                best = rec
+    return {
+        "fleet_p99_ms": float(best["value"]),
+        "fleet_tiles_per_s_per_replica": float(
+            best["tiles_per_s_per_replica"]
+        ),
+    }
 
 
 def measure(args) -> Dict[str, float]:

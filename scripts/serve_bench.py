@@ -84,6 +84,28 @@ def make_tiny_run(
     return cfg
 
 
+def parse_priority_mix(mix: str, clients: int) -> int:
+    """``I:B`` client-ratio string → how many of ``clients`` are bulk.
+
+    ``"1:0"`` (default) = all interactive; ``"3:1"`` = one bulk client per
+    three interactive.  Bulk clients send ``priority=batch`` requests —
+    the arm that shows bulk tiling work queuing without touching the
+    interactive tail."""
+    try:
+        i_share, b_share = (int(x) for x in mix.split(":"))
+    except ValueError:
+        raise SystemExit(f"--priority-mix takes I:B (e.g. 3:1), got {mix!r}")
+    if i_share < 0 or b_share < 0 or i_share + b_share == 0:
+        raise SystemExit(f"--priority-mix shares must be >= 0, got {mix!r}")
+    n = round(clients * b_share / (i_share + b_share))
+    if b_share > 0 and clients > 0:
+        # A requested mix must actually send bulk traffic: 3:1 with 2
+        # clients rounds to 0 otherwise, and the bench would measure a
+        # pure-interactive load while claiming a mix.
+        n = max(1, min(clients, n))
+    return n
+
+
 def run_load(
     workdir: str,
     clients: int,
@@ -91,6 +113,9 @@ def run_load(
     scene: int,
     max_batch: int,
     max_wait_ms: float,
+    quantize: str = "bf16",
+    batcher: str = "continuous",
+    priority_mix: str = "1:0",
 ) -> dict:
     import numpy as np
 
@@ -99,7 +124,7 @@ def run_load(
     from ddlpc_tpu.serve.server import ServingFrontend
 
     engine = InferenceEngine.from_workdir(
-        workdir, max_bucket=max_batch, echo=False
+        workdir, max_bucket=max_batch, echo=False, quantize=quantize
     )
     cfg = ServeConfig(
         workdir=workdir,
@@ -107,6 +132,9 @@ def run_load(
         max_wait_ms=max_wait_ms,
         queue_limit=max(4 * max_batch * clients, 64),
         deadline_ms=0.0,  # closed loop saturates; deadlines would just shed
+        batcher=batcher,
+        quantize=quantize,
+        batch_queue_limit=max(4 * max_batch * clients, 256),
     )
     frontend = ServingFrontend(engine, cfg)
 
@@ -117,6 +145,7 @@ def run_load(
         rng.uniform(0, 1, (h, w, engine.channels)).astype(np.float32)
         for _ in range(clients)
     ]
+    n_bulk = parse_priority_mix(priority_mix, clients)
     # Warmup: compile every bucket the steady loop can hit before timing —
     # otherwise p99 measures XLA compile spikes, not serving latency.
     engine.warmup()
@@ -127,9 +156,12 @@ def run_load(
     errors = []
 
     def client(i: int) -> None:
+        # The LAST n_bulk clients are the bulk tier (stable under any
+        # clients count, so a mix is reproducible).
+        priority = "batch" if i >= clients - n_bulk else "interactive"
         for _ in range(per_client):
             try:
-                frontend.predict_classes(images[i])
+                frontend.predict_classes(images[i], priority=priority)
             except Exception as e:  # noqa: BLE001 — reported, not raised
                 errors.append(repr(e))
 
@@ -143,6 +175,7 @@ def run_load(
         t.join()
     wall_s = time.perf_counter() - t0
     snap = frontend.metrics.snapshot()
+    hbm = engine.hbm_bytes()
     frontend.close(drain=True)
 
     p99 = snap["p99_ms"]
@@ -155,16 +188,25 @@ def run_load(
         ),
         "p50_ms": snap["p50_ms"],
         "p95_ms": snap["p95_ms"],
+        "interactive_p99_ms": snap.get("interactive_p99_ms"),
+        "batch_p99_ms": snap.get("batch_p99_ms"),
         "tiles_per_sec": snap["tiles_per_sec"],
+        # Single engine = one replica: the per-replica throughput the
+        # fleet arm divides out is the same number here.
+        "tiles_per_s_per_replica": snap["tiles_per_sec"],
         "requests_per_sec": snap["requests_per_sec"],
         "batch_occupancy": snap["batch_occupancy"],
         "tiles": snap["tiles"],
         "shed": snap["shed"],
         "errors": len(errors),
         "clients": clients,
+        "bulk_clients": n_bulk,
         "scene_requests": per_client * clients,
         "wall_s": round(wall_s, 3),
         "max_batch": max_batch,
+        "quantize": quantize,
+        "batcher": batcher,
+        "param_bytes": hbm["params"],
     }
 
 
@@ -177,6 +219,9 @@ def run_fleet_load(
     max_batch: int,
     max_wait_ms: float,
     warmup_timeout_s: float = 300.0,
+    quantize: str = "bf16",
+    batcher: str = "continuous",
+    priority_mix: str = "1:0",
 ) -> dict:
     """``--fleet N`` arm: closed-loop load through the FLEET path — router
     dispatch over N real engine-replica subprocesses on this host (each a
@@ -205,6 +250,9 @@ def run_fleet_load(
         hedge_ms=0.0,  # a saturating bench measures capacity, not tail
         scrape_every_s=0.5,
         warmup_timeout_s=warmup_timeout_s,
+        quantize=quantize,
+        batcher=batcher,
+        batch_queue_limit=max(4 * max_batch * clients, 256),
     )
 
     def env_fn(idx: int, launch: int):
@@ -237,11 +285,13 @@ def run_fleet_load(
     router.metrics.snapshot()
 
     per_client = max(requests // clients, 1)
+    n_bulk = parse_priority_mix(priority_mix, clients)
     errors = []
 
     def client(i: int) -> None:
+        query = "priority=batch" if i >= clients - n_bulk else ""
         for _ in range(per_client):
-            status, _, _ = router.dispatch(body)
+            status, _, _ = router.dispatch(body, query)
             if status >= 500:
                 errors.append(status)
 
@@ -258,6 +308,7 @@ def run_fleet_load(
     sup.stop()
 
     p99 = snap["p99_ms"]
+    req_rate = (per_client * clients) / wall_s
     return {
         "metric": "fleet_p99_ms",
         "value": p99,
@@ -267,17 +318,25 @@ def run_fleet_load(
         ),
         "p50_ms": snap["p50_ms"],
         "p95_ms": snap["p95_ms"],
-        "requests_per_sec": round((per_client * clients) / wall_s, 3),
+        "requests_per_sec": round(req_rate, 3),
+        # Fleet requests are one tile each, so this is the accelerator
+        # throughput one replica sustains — the ROADMAP acceptance
+        # metric alongside fleet_p99_ms.
+        "tiles_per_s_per_replica": round(req_rate / replicas, 3),
         "requests": snap["requests"],
         "errors_5xx": snap["errors_5xx"],
         "retries": snap["retries"],
         "hedges": snap["hedges"],
+        "batch_shed": snap["batch_shed"],
         "bench_errors": len(errors),
         "replicas": replicas,
         "clients": clients,
+        "bulk_clients": n_bulk,
         "startup_s": round(startup_s, 1),
         "wall_s": round(wall_s, 3),
         "max_batch": max_batch,
+        "quantize": quantize,
+        "batcher": batcher,
     }
 
 
@@ -307,6 +366,21 @@ def main() -> int:
         "--tile", type=int, default=32,
         help="(--fleet) request tile edge — fleet requests are one tile",
     )
+    p.add_argument(
+        "--quantize", choices=("off", "int8", "bf16"), default="bf16",
+        help="weight-quantization mode for the engine(s) "
+        "(serve/quantized.py; default = the shipped ServeConfig default)",
+    )
+    p.add_argument(
+        "--batcher", choices=("continuous", "coalesce"), default="continuous",
+        help="admission loop: continuous refill (serve/cbatch.py) or "
+        "PR 1's coalesce-and-wait MicroBatcher",
+    )
+    p.add_argument(
+        "--priority-mix", default="1:0", metavar="I:B",
+        help="interactive:bulk client ratio (e.g. 3:1); bulk clients "
+        "send priority=batch requests",
+    )
     args = p.parse_args()
 
     def run(workdir: str) -> dict:
@@ -314,10 +388,14 @@ def main() -> int:
             return run_fleet_load(
                 workdir, args.fleet, args.clients, args.requests,
                 args.tile, args.max_batch, args.max_wait_ms,
+                quantize=args.quantize, batcher=args.batcher,
+                priority_mix=args.priority_mix,
             )
         return run_load(
             workdir, args.clients, args.requests, args.scene,
             args.max_batch, args.max_wait_ms,
+            quantize=args.quantize, batcher=args.batcher,
+            priority_mix=args.priority_mix,
         )
 
     if args.workdir:

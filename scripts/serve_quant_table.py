@@ -1,0 +1,168 @@
+"""The quality-vs-latency-vs-HBM table for weight-quantized serving.
+
+The serve-side quantization claims (docs/SERVING.md "Continuous batching
+& quantized inference") are only honest on a task that can FAIL —
+``synthetic_hard`` (docs/HARD_TASK.md), whose sub-16-px rare classes are
+exactly what a lossy weight lattice would hurt first.  Protocol:
+
+1. train ONE small full-resolution U-Net on ``synthetic_hard`` (the
+   checkpoint is the single ground truth every arm shares — post-training
+   quantization never retrains);
+2. restore that one checkpoint into engines with ``quantize`` ∈
+   {off, bf16, int8} (+ the activation-quantization knob arms);
+3. for each arm: held-out mIoU through the engine's own forward path,
+   median batched-forward latency, and the resident inference-state
+   bytes the engine actually carries (``engine.hbm_bytes()``).
+
+Writes ``docs/serve_quant/quant_table.json`` (atomic).  CPU-feasible:
+~10 min at the default 128² / 30 epochs on a 2-core host; the committed
+run's numbers are in docs/SERVING.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def train_run(workdir: str, size: int, epochs: int) -> dict:
+    from ddlpc_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+    from ddlpc_tpu.train.trainer import Trainer
+
+    cfg = ExperimentConfig(
+        model=ModelConfig(
+            features=(16, 32), bottleneck_features=32, num_classes=6
+        ),
+        data=DataConfig(
+            dataset="synthetic_hard",
+            image_size=(size, size),
+            num_classes=6,
+            synthetic_len=40,
+            test_split=8,
+        ),
+        train=TrainConfig(
+            epochs=epochs,
+            micro_batch_size=2,
+            sync_period=2,
+            learning_rate=3e-3,
+            dump_images_per_epoch=0,
+            checkpoint_every_epochs=epochs,
+            eval_every_epochs=epochs,
+            keep_checkpoints=1,
+        ),
+        workdir=workdir,
+    )
+    summary = Trainer(cfg, resume=False).fit()
+    return {"train_val_miou": float(summary["val_miou"])}
+
+
+def eval_arm(workdir: str, quantize: str, act: bool, batch: int = 8) -> dict:
+    import numpy as np
+
+    from ddlpc_tpu.config import ExperimentConfig
+    from ddlpc_tpu.data import build_dataset
+    from ddlpc_tpu.serve.engine import InferenceEngine
+
+    engine = InferenceEngine.from_workdir(
+        workdir, max_bucket=batch, echo=False, quantize=quantize,
+        quantize_activations=act,
+    )
+    with open(os.path.join(workdir, "config.json")) as f:
+        cfg = ExperimentConfig.from_json(f.read())
+    _, test_ds = build_dataset(cfg.data)
+    n_classes = cfg.data.num_classes
+    conf = np.zeros((n_classes, n_classes), np.int64)
+    for i in range(0, len(test_ds), batch):
+        idx = np.arange(i, min(i + batch, len(test_ds)))
+        images, labels = test_ds.gather(idx)
+        logits = engine.forward_windows(images)
+        pred = logits.argmax(-1)
+        conf += np.bincount(
+            (labels.ravel() * n_classes + pred.ravel()).astype(np.int64),
+            minlength=n_classes * n_classes,
+        ).reshape(n_classes, n_classes)
+    inter = np.diag(conf).astype(np.float64)
+    union = conf.sum(0) + conf.sum(1) - np.diag(conf)
+    iou = inter / np.maximum(union, 1)
+    miou = float(iou[union > 0].mean())
+
+    # Latency: median ms per full-bucket batched forward (steady state —
+    # warmup() precompiled the buckets during the mIoU pass above).
+    th, tw = engine.tile
+    x = np.random.default_rng(0).uniform(
+        0, 1, (batch, th, tw, engine.channels)
+    ).astype(np.float32)
+    engine.forward_windows(x)
+    times = []
+    for _ in range(15):
+        t0 = time.perf_counter()
+        engine.forward_windows(x)
+        times.append(time.perf_counter() - t0)
+    hbm = engine.hbm_bytes()
+    return {
+        "quantize": quantize,
+        "quantize_activations": act,
+        "val_miou": round(miou, 4),
+        "iou_per_class": [round(float(v), 4) for v in iou],
+        "forward_ms_batch8": round(
+            float(np.median(times)) * 1e3, 3
+        ),
+        "ms_per_tile": round(float(np.median(times)) * 1e3 / batch, 3),
+        "param_bytes": int(hbm["params"]),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workdir", default="runs/serve_quant_table")
+    ap.add_argument("--size", type=int, default=128)
+    ap.add_argument("--epochs", type=int, default=30)
+    ap.add_argument(
+        "--out", default=os.path.join("docs", "serve_quant", "quant_table.json")
+    )
+    ap.add_argument(
+        "--skip-train", action="store_true",
+        help="reuse an existing checkpoint in --workdir",
+    )
+    args = ap.parse_args()
+
+    from ddlpc_tpu.utils.fsio import atomic_write_json
+
+    report = {"task": "synthetic_hard", "size": args.size,
+              "epochs": args.epochs, "workdir": args.workdir}
+    if not args.skip_train:
+        report.update(train_run(args.workdir, args.size, args.epochs))
+    arms = [
+        ("off", False),
+        ("bf16", False),
+        ("int8", False),
+        ("bf16", True),
+        ("int8", True),
+    ]
+    rows = []
+    for mode, act in arms:
+        row = eval_arm(args.workdir, mode, act)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    fp32 = rows[0]["val_miou"]
+    for row in rows:
+        row["miou_delta_vs_fp32"] = round(row["val_miou"] - fp32, 4)
+    report["arms"] = rows
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    atomic_write_json(args.out, report)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

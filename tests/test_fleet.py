@@ -337,3 +337,121 @@ def test_fleet_soak_quick_survives(tmp_path):
     assert report["events"]["reload_2_aborted"]["ok"] is False
     assert report["events"]["reload_2_aborted"]["rollback_clean"] is True
     assert report["survived"] is True
+
+
+# ---- priority/quantize knobs + quantized rolling reload (ISSUE 13) ----------
+
+
+def test_fleet_config_forwards_quantize_and_batcher_knobs(tmp_path):
+    cfg = FleetConfig(
+        quantize="int8", batcher="continuous", slots=3,
+        batch_queue_limit=128, starvation_every=2,
+        quantize_activations=True, batch_shed_queue_depth=16,
+    )
+    sc = cfg.replica_serve_config(metrics_dir=str(tmp_path))
+    assert sc.quantize == "int8"
+    assert sc.quantize_activations is True
+    assert sc.batcher == "continuous"
+    assert sc.slots == 3
+    assert sc.batch_queue_limit == 128
+    assert sc.starvation_every == 2
+    # router-side knob stays router-side
+    assert not hasattr(sc, "batch_shed_queue_depth")
+    back = FleetConfig.from_json(cfg.to_json())
+    assert back == cfg
+
+
+class _StatefulReloadClient:
+    """Fake replica client for the rolling-reload protocol: tracks the
+    step it serves, scripts the reload outcome per call."""
+
+    def __init__(self, name, outcomes):
+        self.name = name
+        self.step = 1
+        self.outcomes = list(outcomes)  # per reload call: "ok"|"quarantine"
+        self.reload_calls = []
+
+    def healthz(self, timeout_s):
+        return {
+            "status": "ok",
+            "checkpoint_step": self.step,
+            "queue_depth": 0,
+            "quant_mode": "int8",
+        }
+
+    def reload(self, payload, timeout_s):
+        self.reload_calls.append(dict(payload))
+        outcome = self.outcomes.pop(0) if self.outcomes else "ok"
+        if outcome == "quarantine":
+            # The reader quarantined the new blob and fell back: serving
+            # continues on the OLD step — exactly what a quantized
+            # replica's engine does (test_cbatch pins the engine half).
+            return 200, {"step": self.step, "quarantined_steps": [2]}
+        self.step = payload.get("step", self.step + 1)
+        return 200, {"step": self.step, "version": 1}
+
+    def predict(self, body, query, timeout_s, cancel=None):
+        return 200, "application/x-npy", b"ok"
+
+
+def test_rolling_reload_quantized_fleet_rolls_back_on_quarantine():
+    """Fleet-wide rollback, quantized replicas: r0 takes the new step,
+    r1's copy quarantines → the WHOLE fleet is pinned back to the old
+    step with explicit step= reloads, and the update reports aborted."""
+    from ddlpc_tpu.serve.fleet import ReplicaSupervisor
+    from ddlpc_tpu.serve.router import FleetRouter
+
+    cfg = FleetConfig(
+        replicas=2, quantize="int8", scrape_every_s=0.0,
+        metrics_every_s=0.0, drain_timeout_s=0.5, scrape_timeout_s=0.2,
+    )
+    router = FleetRouter(cfg)
+    sup = ReplicaSupervisor(cfg, router=router, echo=False)
+    clients = [
+        _StatefulReloadClient("r0", ["ok"]),
+        _StatefulReloadClient("r1", ["quarantine"]),
+    ]
+    for rp, cl in zip(sup.replicas, clients):
+        rp.client = cl
+        rp.ready_evt.set()
+        router.add_replica(rp.name, cl)
+
+    res = sup.rolling_reload()
+    assert res["ok"] is False
+    assert res["aborted_on"] == "r1"
+    assert "quarantined" in res["reason"]
+    assert res["rolled_back_to"] == 1
+    assert res["rollback_clean"] is True
+    # r0 was updated to step 2, then explicitly pinned back to step 1.
+    assert clients[0].reload_calls[-1] == {"step": 1}
+    assert clients[0].step == 1
+    # r1 (already serving fallback weights) got the same explicit pin.
+    assert clients[1].reload_calls[-1] == {"step": 1}
+    assert router.metrics.snapshot()["reloads_aborted"] == 1
+    # Both replicas were readmitted: dispatch flows after the abort.
+    status, _, _ = router.dispatch(b"img")
+    assert status == 200
+
+
+def test_rolling_reload_quantized_fleet_success_path():
+    from ddlpc_tpu.serve.fleet import ReplicaSupervisor
+    from ddlpc_tpu.serve.router import FleetRouter
+
+    cfg = FleetConfig(
+        replicas=2, quantize="bf16", scrape_every_s=0.0,
+        metrics_every_s=0.0, drain_timeout_s=0.5, scrape_timeout_s=0.2,
+    )
+    router = FleetRouter(cfg)
+    sup = ReplicaSupervisor(cfg, router=router, echo=False)
+    clients = [
+        _StatefulReloadClient("r0", ["ok"]),
+        _StatefulReloadClient("r1", ["ok"]),
+    ]
+    for rp, cl in zip(sup.replicas, clients):
+        rp.client = cl
+        rp.ready_evt.set()
+        router.add_replica(rp.name, cl)
+    res = sup.rolling_reload()
+    assert res["ok"] is True and res["step"] == 2
+    assert [c.step for c in clients] == [2, 2]
+    assert router.metrics.snapshot()["reloads_ok"] == 1

@@ -461,3 +461,177 @@ def test_percentile_helper_matches_numpy():
             float(np.percentile(vals, q))
         )
     assert _percentile([], 50) is None
+
+
+# ---- priority classes (ISSUE 13) --------------------------------------------
+
+
+def test_scrape_parses_priority_depths_and_quant_mode():
+    """The /healthz one-scrape contract now carries per-priority depths
+    and the engine's quant mode; the scrape parser must pick them up."""
+    r = FakeReplica(
+        "r0",
+        health={
+            "queue_depth": 7,
+            "queue_depth_interactive": 5,
+            "queue_depth_batch": 2,
+            "quant_mode": "int8",
+        },
+    )
+    router = make_router([r])
+    router.scrape_once()
+    status = router.replica_status()[0]
+    assert status["queue_depth"] == 7
+    assert status["queue_depth_interactive"] == 5
+    assert status["queue_depth_batch"] == 2
+    assert status["quant_mode"] == "int8"
+
+
+def test_scrape_tolerates_pre_priority_replicas():
+    """A replica that predates the continuous batcher reports only the
+    total depth; interactive mirrors it so the shed rule stays sound."""
+    r = FakeReplica("r0", health={"queue_depth": 9})
+    router = make_router([r])
+    router.scrape_once()
+    status = router.replica_status()[0]
+    assert status["queue_depth_interactive"] == 9
+    assert status["queue_depth_batch"] == 0
+
+
+def test_batch_class_shed_when_interactive_queues_saturated():
+    """With batch_shed_queue_depth armed and EVERY eligible replica's
+    interactive queue at/above it, ?priority=batch requests shed with a
+    policy 503 that never reaches a replica; interactive traffic flows."""
+    a = FakeReplica("a", health={"queue_depth_interactive": 8})
+    b = FakeReplica("b", health={"queue_depth_interactive": 9})
+    router = make_router([a, b], batch_shed_queue_depth=8)
+    router.scrape_once()
+    before = a.calls + b.calls
+    status, _, body = router.dispatch(b"img", "priority=batch")
+    assert status == 503
+    assert "shed" in json.loads(body)["error"]
+    assert a.calls + b.calls == before  # never dispatched
+    status, _, _ = router.dispatch(b"img")  # interactive unaffected
+    assert status == 200
+    snap = router.metrics.snapshot()
+    assert snap["batch_shed"] == 1
+    # A policy shed is not a client-visible FAILURE in the ledger.
+    assert snap["errors_5xx"] == 0
+
+
+def test_batch_class_flows_when_any_replica_has_headroom():
+    a = FakeReplica("a", health={"queue_depth_interactive": 20})
+    b = FakeReplica("b", health={"queue_depth_interactive": 0})
+    router = make_router([a, b], batch_shed_queue_depth=8)
+    router.scrape_once()
+    status, _, _ = router.dispatch(b"img", "priority=batch")
+    assert status == 200
+    assert router.metrics.snapshot()["batch_shed"] == 0
+
+
+def test_batch_requests_are_never_hedged():
+    """Hedging is a tail-latency spend reserved for interactive traffic:
+    a slow primary on a ?priority=batch request runs to completion with
+    no duplicate dispatched."""
+    release = threading.Event()
+
+    def slow(i):
+        def run(cancel):
+            release.wait(5)
+            return OK
+        return run
+
+    slow_r = FakeReplica("slow", behavior=slow)
+    fast_r = FakeReplica("fast")
+    router = make_router(
+        [slow_r, fast_r], hedge_ms=30.0, hedge_max=1, retries=0,
+        request_timeout_ms=5000.0,
+    )
+    with router._lock:
+        router._replicas["fast"].queue_depth = 5  # slow is the primary
+    done = []
+
+    def go():
+        done.append(router.dispatch(b"img", "priority=batch"))
+
+    t = threading.Thread(target=go)
+    t.start()
+    time.sleep(0.15)  # well past hedge_ms: a hedge would have fired
+    assert router.metrics.snapshot()["hedges"] == 0
+    release.set()
+    t.join(timeout=5)
+    assert done and done[0][0] == 200
+
+
+# ---- admission wait on transient no-replica windows (ISSUE 13) --------------
+
+
+def test_admission_waits_out_transient_no_replica_window():
+    """A rolling reload's drain→readmit hand-off can momentarily leave
+    ZERO eligible replicas; with budget in no_replica_wait_ms the request
+    rides it out as tail latency instead of a client-visible 503."""
+    r = FakeReplica("r0")
+    router = make_router([r], no_replica_wait_ms=2000.0)
+    assert router.drain("r0", timeout_s=1.0)  # nothing in flight
+
+    def readmit_soon():
+        time.sleep(0.1)
+        router.readmit("r0")
+
+    t = threading.Thread(target=readmit_soon)
+    t.start()
+    t0 = time.monotonic()
+    status, _, body = router.dispatch(b"img")
+    t.join()
+    assert (status, body) == (200, b"ok")
+    assert time.monotonic() - t0 >= 0.1  # it actually waited
+    assert router.metrics.snapshot()["errors_5xx"] == 0
+
+
+def test_admission_fails_fast_with_wait_disabled():
+    r = FakeReplica("r0")
+    router = make_router([r], no_replica_wait_ms=0.0)
+    assert router.drain("r0", timeout_s=1.0)
+    status, _, _ = router.dispatch(b"img")
+    assert status == 503
+    assert router.metrics.snapshot()["errors_5xx"] == 1
+
+
+def test_admission_wait_still_503s_on_a_real_outage():
+    """The wait is bounded: a genuinely empty fleet still answers 503
+    after no_replica_wait_ms, not a hang."""
+    r = FakeReplica("r0")
+    router = make_router([r], no_replica_wait_ms=50.0)
+    assert router.drain("r0", timeout_s=1.0)
+    t0 = time.monotonic()
+    status, _, _ = router.dispatch(b"img")
+    waited = time.monotonic() - t0
+    assert status == 503
+    assert 0.04 <= waited < 2.0
+
+
+def test_retry_waits_out_transient_no_replica_window():
+    """The retry pick honors no_replica_wait_ms too: r0 5xxes and its
+    breaker opens while r1 is draining for a rolling reload — the retry
+    finds zero eligible replicas (the tried-replica fallback has nowhere
+    to fall either), waits, and lands on r1 when it readmits instead of
+    answering an instant client-visible 503."""
+    r0 = FakeReplica("r0", behavior=lambda i: (500, "application/json", b"{}"))
+    r1 = FakeReplica("r1")
+    router = make_router(
+        [r0, r1], retries=2, no_replica_wait_ms=2000.0,
+        breaker_window=2, breaker_min_samples=1, breaker_error_rate=0.4,
+    )
+    assert router.drain("r1", timeout_s=1.0)
+
+    def readmit_soon():
+        time.sleep(0.1)
+        router.readmit("r1")
+
+    t = threading.Thread(target=readmit_soon)
+    t.start()
+    status, _, body = router.dispatch(b"img")
+    t.join()
+    assert (status, body) == (200, b"ok")
+    assert r1.calls == 1  # the retry landed on the readmitted replica
+    assert router.metrics.snapshot()["errors_5xx"] == 0
