@@ -56,6 +56,11 @@ MODULE_TIERS: Dict[str, str] = {
     "ddlpc_tpu.obs.hbm": STDLIB,
     "ddlpc_tpu.obs.profiling": STDLIB,  # jax reached lazily, per capture
     "ddlpc_tpu.obs.xplane": STDLIB,  # TF proto import is optional/lazy
+    # fleet observability (ISSUE 14): the trace merger and the telemetry
+    # aggregator run in router/CI processes — provably jax-free, like the
+    # routing tier they serve.
+    "ddlpc_tpu.obs.merge": STDLIB,
+    "ddlpc_tpu.obs.aggregate": STDLIB,
     # resilience: the supervisor must restart a crashed trainer without
     # importing what crashed it.
     "ddlpc_tpu.resilience": STDLIB,

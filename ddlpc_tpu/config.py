@@ -544,6 +544,38 @@ class FleetConfig:
     backoff_base_s: float = 0.5
     backoff_cap_s: float = 30.0
     metrics_every_s: float = 10.0  # router.jsonl snapshot cadence; 0 = off
+    # Distributed tracing (docs/OBSERVABILITY.md "Distributed tracing"):
+    # the router mints one W3C-style trace id per request, records
+    # route_request/router_attempt spans to <fleet_dir>/router_spans.jsonl,
+    # and forwards the context to the replica on the traceparent header;
+    # replicas (which inherit this knob via replica_serve_config) stamp it
+    # into their serve_request spans, so obs/merge.py can stitch the
+    # per-process streams into ONE fleet timeline.
+    trace: bool = False
+    # Fleet telemetry aggregation (obs/aggregate.py): the front end
+    # scrapes every replica's /metrics (plus the router's own registry)
+    # every ``aggregate_every_s`` into ddlpc_fleet_* rollups on the fleet
+    # /metrics; a source whose last successful scrape is older than
+    # ``aggregate_stale_after_s`` is flagged stale and dropped from the
+    # rollups (its last per-replica series stay visible).  0 = off.
+    aggregate_every_s: float = 2.0
+    aggregate_stale_after_s: float = 15.0
+    # SLO layer (obs/health.py:SLOTracker): a routed request is GOOD when
+    # it succeeds (no 5xx) within its class's latency objective; the
+    # availability objective says what fraction must be good.  Burn-rate
+    # alerts fire on two windows (fast = page-grade outage, slow = budget
+    # leak), latched like every other health detector; error budgets and
+    # burn rates ride the fleet /healthz and kind="slo" records on
+    # router.jsonl.
+    slo_enabled: bool = True
+    slo_interactive_p99_ms: float = 1000.0  # latency objective per class
+    slo_batch_p99_ms: float = 10000.0
+    slo_availability: float = 0.999  # good-request fraction objective
+    slo_budget_window_s: float = 3600.0  # error-budget accounting window
+    slo_fast_window_s: float = 300.0
+    slo_fast_burn: float = 14.0  # burn-rate threshold (critical)
+    slo_slow_window_s: float = 3600.0
+    slo_slow_burn: float = 2.0  # burn-rate threshold (warn)
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -590,6 +622,9 @@ class FleetConfig:
             quantize_activations=self.quantize_activations,
             drain_timeout_s=self.drain_timeout_s,
             metrics_dir=metrics_dir,
+            # Trace context crosses the process boundary only if the
+            # replica traces too (spans land in ITS metrics_dir).
+            trace=self.trace,
         )
 
 

@@ -17,7 +17,13 @@ One subsystem, four capabilities, shared by training and serving:
   (docs/PERF.md "Accounting"): the per-step conv FLOP model behind the
   live ``ddlpc_mfu``/``ddlpc_goodput`` gauges, exact per-collective wire
   byte counters + the fenced comm-time probe, and per-device HBM gauges
-  from shape × committed sharding.
+  from shape × committed sharding;
+- :mod:`merge` / :mod:`aggregate` — the fleet layer
+  (docs/OBSERVABILITY.md "Distributed tracing & fleet aggregation"):
+  per-process span streams stitched into one Perfetto timeline on the
+  W3C-style ``traceparent`` context, and every replica's registry rolled
+  up into ``ddlpc_fleet_*`` on the fleet ``/metrics``; SLO error budgets
+  + burn-rate alerts live in :mod:`health` (``SLOTracker``).
 
 Everything except :mod:`profiling`/:mod:`xplane` is pure stdlib — no jax
 import at module scope — so the tracer and registry are importable (and

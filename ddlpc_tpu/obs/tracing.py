@@ -27,6 +27,17 @@ enabled — measured numbers in docs/OBSERVABILITY.md):
   attribute test — no allocation, no clock read, no lock;
 - enabled, a span costs two ``perf_counter`` reads, one dict/list append
   under the lock, and one buffered file write.
+
+Cross-process trace context (the fleet tentpole, ISSUE 14): a request's
+identity is a W3C-style pair — a 32-hex ``trace_id`` plus a 16-hex span
+id — carried between processes on the ``traceparent`` HTTP header
+(``00-<trace_id>-<span_id>-01``).  :meth:`Tracer.bind` installs a
+(trace_id, remote parent) pair on the CURRENT THREAD; every span recorded
+under the binding stamps that ``trace_id`` into its JSONL record instead
+of the tracer's own run id, and a binding's ROOT spans (no local parent)
+additionally record ``remote_parent`` — the hex span id of the upstream
+process's span — so ``obs/merge.py`` can stitch the per-process streams
+into one fleet timeline.
 """
 
 from __future__ import annotations
@@ -37,10 +48,59 @@ import os
 import threading
 import time
 import uuid
-from typing import Dict, List, Optional
+from contextlib import contextmanager, nullcontext
+from typing import Dict, List, Optional, Tuple
 
 from ddlpc_tpu.analysis import lockcheck
 from ddlpc_tpu.obs.schema import SCHEMA_VERSION
+
+# -- cross-process trace context (W3C traceparent shape) ---------------------
+
+TRACEPARENT_HEADER = "traceparent"
+
+_NULL_BIND = nullcontext()
+
+
+def new_trace_id() -> str:
+    """32 lowercase hex chars — one per REQUEST, shared across processes."""
+    return uuid.uuid4().hex
+
+
+def new_span_hex() -> str:
+    """16 lowercase hex chars — a globally-unique span id for spans that
+    must be referenced from ANOTHER process (the router's attempt spans)."""
+    return uuid.uuid4().hex[:16]
+
+
+def format_traceparent(trace_id: str, span_hex: str) -> str:
+    """``00-<trace_id>-<span_id>-01`` (version 00, sampled flag)."""
+    return f"00-{trace_id}-{span_hex}-01"
+
+
+_HEX_DIGITS = frozenset("0123456789abcdef")
+
+
+def _is_hex(s: str, n: int) -> bool:
+    # Explicit charset, not int(s, 16): the W3C shape is LOWERCASE hex,
+    # and int() would wave through '+'/'_'-decorated strings.
+    return len(s) == n and all(c in _HEX_DIGITS for c in s)
+
+
+def parse_traceparent(value: Optional[str]) -> Optional[Tuple[str, str]]:
+    """(trace_id, parent span hex) from a ``traceparent`` header, or None
+    for anything malformed — a bad header must degrade to a fresh local
+    trace, never into a request error."""
+    if not value:
+        return None
+    parts = value.strip().split("-")
+    if len(parts) != 4:
+        return None
+    _, trace_id, span_hex, _ = parts
+    if not _is_hex(trace_id, 32) or not _is_hex(span_hex, 16):
+        return None
+    if trace_id == "0" * 32 or span_hex == "0" * 16:
+        return None
+    return trace_id, span_hex
 
 
 class _NullSpan:
@@ -135,6 +195,7 @@ class Tracer:
         self.max_events = int(max_events)
         self._lock = lockcheck.lock("Tracer._lock")
         self._id = 0  # guarded-by: _lock
+        self._pid = os.getpid()
         self._tls = threading.local()
         self._events: list = []  # guarded-by: _lock
         self._thread_names: dict = {}  # guarded-by: _lock
@@ -173,6 +234,38 @@ class Tracer:
         """The tracer's clock (pair with :meth:`add_span`)."""
         return time.perf_counter() if self.enabled else 0.0
 
+    # -- cross-process trace context ---------------------------------------
+
+    def bind(self, trace_id: Optional[str], parent_hex: Optional[str] = None):
+        """Context manager installing a request's cross-process identity on
+        the CURRENT THREAD: spans recorded inside stamp ``trace_id`` into
+        their JSONL records, and root spans (no local parent) record
+        ``remote_parent=parent_hex`` — how a replica's ``serve_request``
+        points back at the router attempt that dispatched it.  No-op when
+        disabled or ``trace_id`` is None (a request with no/invalid
+        ``traceparent`` keeps the tracer's own run id)."""
+        if not self.enabled or trace_id is None:
+            return _NULL_BIND
+        return self._bind_ctx(trace_id, parent_hex)
+
+    @contextmanager
+    def _bind_ctx(self, trace_id: str, parent_hex: Optional[str]):
+        prev = getattr(self._tls, "ctx", None)
+        self._tls.ctx = (trace_id, parent_hex)
+        try:
+            yield self
+        finally:
+            self._tls.ctx = prev
+
+    def current_trace_id(self) -> Optional[str]:
+        """The bound request trace id on this thread, or None.  The
+        batchers capture it at submit so batch spans executed on a worker
+        thread can name every request trace they served."""
+        if not self.enabled:
+            return None
+        ctx = getattr(self._tls, "ctx", None)
+        return ctx[0] if ctx is not None else None
+
     # -- internals ---------------------------------------------------------
 
     def _stack(self) -> list:
@@ -196,32 +289,51 @@ class Tracer:
         tid: int,
         attrs: dict,
     ) -> None:
-        flat = {
-            k: (v if isinstance(v, (str, int, float, bool, type(None))) else str(v))
-            for k, v in attrs.items()
-        }
+        flat = {}
+        for k, v in attrs.items():
+            if isinstance(v, (str, int, float, bool, type(None))):
+                flat[k] = v
+            elif isinstance(v, (list, tuple)) and all(
+                isinstance(x, (str, int, float, bool, type(None))) for x in v
+            ):
+                # Lists of scalars are schema-legal (check_record) — the
+                # batchers' trace_ids attribute rides through as-is.
+                flat[k] = list(v)
+            else:
+                flat[k] = str(v)
         line = None
         if self._jsonl is not None:
+            # A thread bound to a request's cross-process context stamps
+            # the REQUEST trace id (and, on root spans, the remote parent)
+            # instead of the tracer's run id — the field obs/merge.py
+            # groups on.  ctx belongs to the RECORDING thread: add_span
+            # callers (batcher workers) carry request identity via attrs.
+            ctx = getattr(self._tls, "ctx", None)
             rec = {
                 "schema": SCHEMA_VERSION,
                 "kind": "span",
                 "service": self.service,
-                "trace_id": self.trace_id,
+                "trace_id": ctx[0] if ctx is not None else self.trace_id,
                 "span_id": span_id,
                 "parent_id": parent_id,
                 "name": name,
                 "time": round(self._epoch0 + t0, 6),
                 "dur_s": round(t1 - t0, 9),
+                "pid": self._pid,
                 "tid": tid,
                 **flat,
             }
+            if ctx is not None and parent_id == 0 and ctx[1]:
+                rec["remote_parent"] = ctx[1]
             line = json.dumps(rec) + "\n"
         ev = {
             "name": name,
             "ph": "X",
             "ts": (t0 - self._t0) * 1e6,  # microseconds, trace-relative
             "dur": max((t1 - t0) * 1e6, 0.0),
-            "pid": os.getpid(),
+            # cached: getpid() is a real syscall (~17 us under gVisor) and
+            # this is the per-span hot path
+            "pid": self._pid,
             "tid": tid,
         }
         if flat:
@@ -255,7 +367,7 @@ class Tracer:
         with self._lock:
             events = list(self._events)
             names = dict(self._thread_names)
-        pid = os.getpid()
+        pid = self._pid  # must match the per-event pid (cached at init)
         meta = [
             {
                 "name": "process_name",

@@ -48,10 +48,12 @@ class EngineClosed(RuntimeError):
 
 
 class _Item:
-    __slots__ = ("payload", "future", "enqueued", "deadline", "t_trace")
+    __slots__ = ("payload", "future", "enqueued", "deadline", "t_trace",
+                 "trace_id")
 
     def __init__(
-        self, payload, deadline: Optional[float], now: float, t_trace: float = 0.0
+        self, payload, deadline: Optional[float], now: float,
+        t_trace: float = 0.0, trace_id: Optional[str] = None,
     ):
         self.payload = payload
         self.future: Future = Future()
@@ -60,6 +62,10 @@ class _Item:
         # Enqueue time on the tracer's clock (tracing enabled only): the
         # worker records the cross-thread enqueue→batch-take wait with it.
         self.t_trace = t_trace
+        # Request trace id captured on the submitting thread (the one
+        # holding the tracer binding) — worker-thread batch spans name
+        # the request traces they serve via this (obs/merge.py).
+        self.trace_id = trace_id
 
 
 def _fail(future: Future, exc: Exception) -> None:
@@ -162,12 +168,14 @@ class MicroBatcher:
                     f"queue full ({len(self._q)}/{self.queue_limit} + "
                     f"{len(payloads)} new); retry with backoff"
                 )
-            t_trace = (
-                self.tracer.now()
-                if self.tracer is not None and self.tracer.enabled
-                else 0.0
-            )
-            items = [_Item(p, deadline, now, t_trace) for p in payloads]
+            t_trace = 0.0
+            trace_id = None
+            if self.tracer is not None and self.tracer.enabled:
+                t_trace = self.tracer.now()
+                trace_id = self.tracer.current_trace_id()
+            items = [
+                _Item(p, deadline, now, t_trace, trace_id) for p in payloads
+            ]
             self._q.extend(items)
             if self.metrics is not None:
                 self.metrics.set_queue_depth(len(self._q))
@@ -233,6 +241,7 @@ class MicroBatcher:
             with self._cond:
                 self.forward_count += 1
             tracer = self.tracer
+            tids = sorted({it.trace_id for it in live if it.trace_id})
             if tracer is not None and tracer.enabled:
                 # Cross-thread coalesce wait: the oldest live member's
                 # enqueue (client thread) → this batch take (worker).
@@ -241,9 +250,13 @@ class MicroBatcher:
                     live[0].t_trace,
                     tracer.now(),
                     batch=len(live),
+                    **({"trace_ids": tids} if tids else {}),
                 )
             span = (
-                tracer.span("jit_execute", batch=len(live))
+                tracer.span(
+                    "jit_execute", batch=len(live),
+                    **({"trace_ids": tids} if tids else {}),
+                )
                 if tracer is not None
                 else _NULL_CTX
             )

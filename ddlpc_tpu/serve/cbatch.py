@@ -75,7 +75,8 @@ def check_priority(priority: str) -> str:
 
 class _Item:
     __slots__ = (
-        "payload", "future", "enqueued", "deadline", "t_trace", "refill"
+        "payload", "future", "enqueued", "deadline", "t_trace", "refill",
+        "trace_id",
     )
 
     def __init__(
@@ -85,12 +86,17 @@ class _Item:
         now: float,
         t_trace: float = 0.0,
         refill: bool = False,
+        trace_id: Optional[str] = None,
     ):
         self.payload = payload
         self.future: Future = Future()
         self.enqueued = now
         self.deadline = deadline
         self.t_trace = t_trace
+        # Request trace id captured on the SUBMITTING thread (the one
+        # holding the tracer binding) — batch spans execute on a worker
+        # thread and name every request trace they served via this.
+        self.trace_id = trace_id
         # True when this item arrived while a forward was executing: the
         # assembly that takes it is a pipeline REFILL (work admitted
         # without waiting for the previous batch's world to drain) — the
@@ -155,6 +161,16 @@ class ContinuousBatcher:
         # forward was in flight: the pipeline stayed hot instead of
         # draining (the continuous-batching property, test-pinned)
         self.refills = 0  # guarded-by: _cond
+        # Per-slot utilization accounting (ISSUE 14 satellite): cumulative
+        # busy seconds per slot + the in-flight forward's start, read out
+        # windowed by slot_busy_fractions() so sizing `slots` stops being
+        # guesswork (published as ddlpc_serve_slot_busy_fraction{slot}).
+        now0 = time.monotonic()
+        self._slot_busy_s = [0.0] * self.slots  # guarded-by: _cond
+        self._slot_t0: List[Optional[float]] = (
+            [None] * self.slots
+        )  # guarded-by: _cond
+        self._slot_mark = [(now0, 0.0)] * self.slots  # guarded-by: _cond
         self._threads: List[threading.Thread] = []
         self._started = False
         if start:
@@ -168,7 +184,8 @@ class ContinuousBatcher:
         self._started = True
         for i in range(self.slots):
             t = threading.Thread(
-                target=self._run, name=f"serve-cbatch-{i}", daemon=True
+                target=self._run, args=(i,), name=f"serve-cbatch-{i}",
+                daemon=True,
             )
             self._threads.append(t)
             t.start()
@@ -213,14 +230,15 @@ class ContinuousBatcher:
                     f"{priority} queue full ({len(q)}/{limit} + "
                     f"{len(payloads)} new); retry with backoff"
                 )
-            t_trace = (
-                self.tracer.now()
-                if self.tracer is not None and self.tracer.enabled
-                else 0.0
-            )
+            t_trace = 0.0
+            trace_id = None
+            if self.tracer is not None and self.tracer.enabled:
+                t_trace = self.tracer.now()
+                trace_id = self.tracer.current_trace_id()
             refill = self._busy > 0
             items = [
-                _Item(p, deadline, now, t_trace, refill) for p in payloads
+                _Item(p, deadline, now, t_trace, refill, trace_id)
+                for p in payloads
             ]
             q.extend(items)
             self._publish_depths_locked()
@@ -285,16 +303,40 @@ class ContinuousBatcher:
             self._publish_depths_locked()
             return batch
 
-    def _run(self) -> None:
+    def _run(self, slot: int) -> None:
         while True:
             batch = self._take_batch()
             if batch is None:
                 return
+            t0 = time.monotonic()
+            with self._cond:
+                self._slot_t0[slot] = t0
             try:
                 self._execute(batch)
             finally:
                 with self._cond:
                     self._busy -= 1
+                    self._slot_busy_s[slot] += time.monotonic() - t0
+                    self._slot_t0[slot] = None
+
+    def slot_busy_fractions(self) -> Dict[int, float]:
+        """Per-slot busy fraction since the PREVIOUS readout (an in-flight
+        forward counts up to now).  The caller's cadence defines the
+        window — the frontend's metrics emitter reads this every
+        ``metrics_every_s`` and publishes
+        ``ddlpc_serve_slot_busy_fraction{slot}``."""
+        now = time.monotonic()
+        out: Dict[int, float] = {}
+        with self._cond:
+            for i in range(self.slots):
+                busy = self._slot_busy_s[i]
+                if self._slot_t0[i] is not None:
+                    busy += now - self._slot_t0[i]
+                last_t, last_busy = self._slot_mark[i]
+                dt = max(now - last_t, 1e-9)
+                out[i] = min(max((busy - last_busy) / dt, 0.0), 1.0)
+                self._slot_mark[i] = (now, busy)
+        return out
 
     def _execute(self, batch: List[_Item]) -> None:
         now = time.monotonic()
@@ -318,15 +360,23 @@ class ContinuousBatcher:
         with self._cond:
             self.forward_count += 1
         tracer = self.tracer
+        # The request trace ids this batch serves (flat list of scalars —
+        # schema-legal): how obs/merge.py attributes worker-thread batch
+        # spans to the cross-process request timelines they belong to.
+        tids = sorted({it.trace_id for it in live if it.trace_id})
         if tracer is not None and tracer.enabled:
             tracer.add_span(
                 "batch_coalesce",
                 live[0].t_trace,
                 tracer.now(),
                 batch=len(live),
+                **({"trace_ids": tids} if tids else {}),
             )
         span = (
-            tracer.span("jit_execute", batch=len(live))
+            tracer.span(
+                "jit_execute", batch=len(live),
+                **({"trace_ids": tids} if tids else {}),
+            )
             if tracer is not None
             else _NULL_CTX
         )

@@ -49,8 +49,10 @@ from typing import Callable, Dict, List, Optional
 from urllib.parse import urlparse
 
 from ddlpc_tpu.config import FleetConfig
-from ddlpc_tpu.obs.http import render_metrics
+from ddlpc_tpu.obs.aggregate import TelemetryAggregator
+from ddlpc_tpu.obs.http import PROMETHEUS_CTYPE, render_metrics, wants_prometheus
 from ddlpc_tpu.obs.registry import MetricsRegistry
+from ddlpc_tpu.obs.tracing import Tracer, parse_traceparent
 from ddlpc_tpu.resilience.supervisor import RestartPolicy, classify_exit
 from ddlpc_tpu.serve.router import FleetRouter, HTTPReplicaClient
 from ddlpc_tpu.serve.server import ServeHTTPServer
@@ -97,6 +99,7 @@ class ReplicaSupervisor:
         logger=None,
         env_fn: Optional[Callable[[int, int], Optional[dict]]] = None,
         echo: bool = True,
+        aggregator: Optional[TelemetryAggregator] = None,
     ):
         self.cfg = cfg
         self.fleet_dir = cfg.resolved_fleet_dir()
@@ -108,6 +111,11 @@ class ReplicaSupervisor:
             if router is not None
             else FleetRouter(cfg, registry=registry, logger=logger)
         )
+        # Fleet telemetry aggregation (obs/aggregate.py): replicas opt in
+        # as metrics sources exactly when they enter dispatch, and leave
+        # when their process dies — the aggregator's staleness flag covers
+        # the gap in between.
+        self.aggregator = aggregator
         self.logger = logger
         self.env_fn = env_fn
         self.echo = echo
@@ -223,6 +231,13 @@ class ReplicaSupervisor:
             if self._wait_ready(rp) and not self._stop.is_set():
                 rp.became_ready = True
                 self.router.add_replica(rp.name, rp.client)
+                if self.aggregator is not None:
+                    client = rp.client
+                    timeout_s = self.cfg.scrape_timeout_s
+                    self.aggregator.add_source(
+                        rp.name,
+                        lambda c=client, t=timeout_s: c.metrics_text(t),
+                    )
                 self._say(f"{rp.name}: ready on port {rp.port}")
                 self._log(
                     "replica_ready", replica=rp.name, port=rp.port,
@@ -239,6 +254,8 @@ class ReplicaSupervisor:
                     pass
             rc = rp.proc.wait() if rp.proc is not None else -1
             self.router.remove_replica(rp.name)
+            if self.aggregator is not None:
+                self.aggregator.remove_source(rp.name)
             rp.ready_evt.clear()
             cause = classify_exit(rc)
             self._say(f"{rp.name}: exit {rc} ({cause})")
@@ -509,6 +526,10 @@ class _FleetHandler(BaseHTTPRequestHandler):
     def supervisor(self) -> Optional[ReplicaSupervisor]:
         return self.server.supervisor  # type: ignore[attr-defined]
 
+    @property
+    def aggregator(self) -> Optional[TelemetryAggregator]:
+        return getattr(self.server, "aggregator", None)
+
     def _send(self, status: int, ctype: str, body: bytes) -> None:
         self.send_response(status)
         self.send_header("Content-Type", ctype or "application/octet-stream")
@@ -525,12 +546,21 @@ class _FleetHandler(BaseHTTPRequestHandler):
             h = self.router.healthz()
             self._send_json(200 if h["status"] == "ok" else 503, h)
         elif path == "/metrics":
+            # One scrape answers for the whole fleet: the router's own
+            # registry plus the aggregator's ddlpc_fleet_* rollups
+            # (per-replica labels preserved) in one exposition.
+            agg = self.aggregator
+            accept = self.headers.get("Accept")
+            if agg is not None and wants_prometheus(accept):
+                body = (
+                    self.router.registry.exposition() + agg.exposition()
+                ).encode()
+                self._send(200, PROMETHEUS_CTYPE, body)
+                return
             ctype, body = render_metrics(
                 self.router.registry,
-                self.headers.get("Accept"),
-                json_fallback=lambda: self.router.metrics.snapshot(
-                    advance=False
-                ),
+                accept,
+                json_fallback=lambda: self._json_metrics(agg),
             )
             self._send(200, ctype, body)
         elif path == "/fleet":
@@ -541,14 +571,26 @@ class _FleetHandler(BaseHTTPRequestHandler):
         else:
             self._send_json(404, {"error": f"no route {path}"})
 
+    def _json_metrics(self, agg: Optional[TelemetryAggregator]) -> dict:
+        out = self.router.metrics.snapshot(advance=False)
+        if agg is not None:
+            out.update(agg.snapshot())
+        return out
+
     def do_POST(self) -> None:
         parsed = urlparse(self.path)
         try:
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length) if length else b""
             if parsed.path == "/predict":
+                # An external client's traceparent continues through the
+                # fleet (its trace id spans client→router→replica);
+                # otherwise a traced router mints a fresh one.
                 status, ctype, payload = self.router.dispatch(
-                    body, parsed.query
+                    body, parsed.query,
+                    trace_context=parse_traceparent(
+                        self.headers.get("traceparent")
+                    ),
                 )
                 self._send(status, ctype, payload)
             elif parsed.path == "/reload":
@@ -579,12 +621,15 @@ def make_fleet_server(
     supervisor: Optional[ReplicaSupervisor] = None,
     host: str = "127.0.0.1",
     port: int = 0,
+    aggregator: Optional[TelemetryAggregator] = None,
 ) -> ServeHTTPServer:
     """Client-facing HTTP server over the router (+ optional supervisor
-    for ``POST /reload`` rolling updates)."""
+    for ``POST /reload`` rolling updates, + optional telemetry
+    aggregator whose ddlpc_fleet_* rollups join ``GET /metrics``)."""
     server = ServeHTTPServer((host, port), _FleetHandler)
     server.router = router  # type: ignore[attr-defined]
     server.supervisor = supervisor  # type: ignore[attr-defined]
+    server.aggregator = aggregator  # type: ignore[attr-defined]
     return server
 
 
@@ -620,10 +665,29 @@ def main(argv: Optional[List[str]] = None) -> int:
     os.makedirs(fleet_dir, exist_ok=True)
     logger = MetricsLogger(fleet_dir, basename="router")
     registry = MetricsRegistry()
-    router = FleetRouter(cfg, registry=registry, logger=logger)
-    sup = ReplicaSupervisor(cfg, router=router, logger=logger)
+    tracer = Tracer(
+        enabled=cfg.trace,
+        service="router",
+        jsonl_path=os.path.join(fleet_dir, "router_spans.jsonl"),
+        chrome_path=os.path.join(fleet_dir, "router_trace.json"),
+    )
+    router = FleetRouter(cfg, registry=registry, logger=logger, tracer=tracer)
+    aggregator = None
+    if cfg.aggregate_every_s > 0:
+        aggregator = TelemetryAggregator(
+            stale_after_s=cfg.aggregate_stale_after_s
+        )
+        # The router's own registry is a source too — its ddlpc_router_*
+        # series roll up beside the replicas' ddlpc_serve_* families.
+        aggregator.add_source("router", registry.exposition)
+        aggregator.start(cfg.aggregate_every_s)
+    sup = ReplicaSupervisor(
+        cfg, router=router, logger=logger, aggregator=aggregator
+    )
     n = sup.start(wait_ready=True)
-    server = make_fleet_server(router, sup, cfg.host, cfg.port)
+    server = make_fleet_server(
+        router, sup, cfg.host, cfg.port, aggregator=aggregator
+    )
     print(
         f"fleet: {n}/{cfg.replicas} replicas ready; routing "
         f"http://{cfg.host}:{server.server_address[1]} -> {cfg.workdir}",
@@ -640,6 +704,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     finally:
         server.server_close()
         sup.stop()
+        if aggregator is not None:
+            aggregator.close()
+        tracer.close()
     return 0
 
 

@@ -56,6 +56,7 @@ class ServeMetrics:
         self.batches = 0
         self.queue_depth = 0
         self.priority_depths = {"interactive": 0, "batch": 0}
+        self.slot_busy: Dict[int, float] = {}
         # False until a priority-aware batcher reports per-class depths;
         # snapshot() then mirrors the single queue into interactive so a
         # coalesce-mode stream never contradicts itself (queue_depth=40,
@@ -101,6 +102,13 @@ class ServeMetrics:
                     "Admission queue depth by priority class "
                     "(continuous batcher).",
                     labelnames=("priority",),
+                ),
+                "slot_busy": registry.gauge(
+                    "ddlpc_serve_slot_busy_fraction",
+                    "Busy fraction of each continuous-batcher slot worker "
+                    "over the last metrics window — the signal for sizing "
+                    "`slots`.",
+                    labelnames=("slot",),
                 ),
             }
 
@@ -164,6 +172,14 @@ class ServeMetrics:
     def priority_queue_depths(self) -> Dict[str, int]:
         with self._lock:
             return dict(self.priority_depths)
+
+    def set_slot_busy(self, fractions: Dict[int, float]) -> None:
+        """Per-slot busy fractions (continuous batcher, emit cadence)."""
+        with self._lock:
+            self.slot_busy = {int(s): float(f) for s, f in fractions.items()}
+        if self._reg is not None:
+            for s, f in fractions.items():
+                self._reg["slot_busy"].set(float(f), slot=str(s))
 
     # ---- readout -----------------------------------------------------------
 

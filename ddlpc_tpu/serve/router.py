@@ -51,7 +51,14 @@ from urllib.parse import parse_qs
 
 from ddlpc_tpu.analysis import lockcheck
 from ddlpc_tpu.config import FleetConfig
+from ddlpc_tpu.obs.health import HealthMonitor, SLOTracker
 from ddlpc_tpu.obs.registry import MetricsRegistry
+from ddlpc_tpu.obs.tracing import (
+    TRACEPARENT_HEADER,
+    format_traceparent,
+    new_span_hex,
+    new_trace_id,
+)
 
 Response = Tuple[int, str, bytes]  # (status, content-type, body)
 
@@ -210,7 +217,11 @@ class CircuitBreaker:
 
 class ReplicaClient:
     """What the router needs from one replica.  Subclasses: the HTTP
-    client below (real fleet) and in-process fakes (tests)."""
+    client below (real fleet) and in-process fakes (tests).
+
+    ``predict``'s ``traceparent`` keyword is only ever passed when the
+    router has TRACING enabled (``FleetConfig.trace``) — pre-existing
+    fakes with the old signature keep working untraced."""
 
     name: str = "?"
 
@@ -220,10 +231,17 @@ class ReplicaClient:
         query: str,
         timeout_s: float,
         cancel: Optional[threading.Event] = None,
+        traceparent: Optional[str] = None,
     ) -> Response:
         raise NotImplementedError
 
     def healthz(self, timeout_s: float) -> dict:
+        raise NotImplementedError
+
+    def metrics_text(self, timeout_s: float) -> str:
+        """Prometheus text exposition from the replica's ``/metrics`` —
+        what the fleet TelemetryAggregator scrapes.  Optional: fakes that
+        never meet an aggregator may skip it."""
         raise NotImplementedError
 
     def reload(self, payload: dict, timeout_s: float) -> Tuple[int, dict]:
@@ -293,13 +311,26 @@ class HTTPReplicaClient(ReplicaClient):
             except Exception:
                 pass
 
-    def predict(self, body, query, timeout_s, cancel=None) -> Response:
+    def predict(
+        self, body, query, timeout_s, cancel=None, traceparent=None
+    ) -> Response:
         path = "/predict" + (f"?{query}" if query else "")
+        headers = {"Content-Type": "application/x-npy"}
+        if traceparent:
+            headers[TRACEPARENT_HEADER] = traceparent
         return self._request(
-            "POST", path, body, timeout_s,
-            headers={"Content-Type": "application/x-npy"},
-            cancel=cancel,
+            "POST", path, body, timeout_s, headers=headers, cancel=cancel,
         )
+
+    def metrics_text(self, timeout_s: float) -> str:
+        """Prometheus text exposition (Accept negotiates it — obs/http.py)."""
+        status, _, body = self._request(
+            "GET", "/metrics", None, timeout_s,
+            headers={"Accept": "text/plain"},
+        )
+        if status != 200:
+            raise ReplicaError(f"{self.name}: /metrics returned {status}")
+        return body.decode("utf-8", errors="replace")
 
     def healthz(self, timeout_s: float) -> dict:
         status, _, body = self._request("GET", "/healthz", None, timeout_s)
@@ -603,6 +634,7 @@ class FleetRouter:
         logger=None,
         rng: Optional[random.Random] = None,
         sleep: Callable[[float], None] = time.sleep,
+        tracer=None,
     ):
         self.cfg = cfg or FleetConfig()
         self.registry = registry if registry is not None else MetricsRegistry()
@@ -610,6 +642,20 @@ class FleetRouter:
         self.logger = logger  # MetricsLogger(basename="router") or None
         self._rng = rng if rng is not None else random.Random()
         self._sleep = sleep
+        # Distributed tracing (ISSUE 14): with an enabled Tracer each
+        # dispatch mints a request trace id, records route_request +
+        # per-attempt spans, and forwards the context to the replica on
+        # the traceparent header.  None/disabled = zero-cost no-op.
+        self.tracer = tracer
+        # SLO layer: every routed request feeds the per-priority latency/
+        # availability objectives; burn-rate alerts ride the health
+        # monitor's fan-out (JSONL + ddlpc_alerts_total + /healthz).
+        self.health = HealthMonitor(
+            logger=logger, registry=self.registry, service="router"
+        )
+        self.slo = SLOTracker.from_fleet_config(
+            self.cfg, registry=self.registry, monitor=self.health
+        )
         self._lock = lockcheck.lock("FleetRouter._lock")
         self._replicas: dict = {}  # guarded-by: _lock
         self._rr = 0  # guarded-by: _lock (round-robin tiebreaker)
@@ -759,6 +805,15 @@ class FleetRouter:
         snap = self.metrics.snapshot()
         if self.logger is not None:
             self.logger.log(snap, echo=False)
+        # SLO status rides the same cadence: burn-rate detectors evaluate
+        # (alerts fan out via the health monitor) and one flat
+        # kind="slo" record lands per emit — the error-budget ledger.
+        self.slo.check()
+        if self.logger is not None and self.slo.enabled:
+            try:
+                self.logger.log(self.slo.status(), echo=False)
+            except Exception:
+                pass  # accounting must never break dispatch
         return snap
 
     def _log_event(self, event: str, **fields) -> None:
@@ -883,6 +938,7 @@ class FleetRouter:
     def _launch_waiting(
         self, body: bytes, query: str, reason: str,
         exclude: Sequence[str], done: "queue.Queue[_Attempt]",
+        trace_id: Optional[str] = None,
     ) -> Optional["_Attempt"]:
         """`_launch` plus the bounded zero-eligible wait: a rolling
         reload's drain→readmit hand-off, a relaunch-readiness gap, and a
@@ -890,33 +946,56 @@ class FleetRouter:
         transient total-outage blip that should surface as tail latency,
         not a client-visible 503.  Admission and the no-pending retry
         pick ride it out the same way (per-pick bound)."""
-        a = self._launch(body, query, reason, exclude, done)
+        a = self._launch(body, query, reason, exclude, done, trace_id)
         if a is None and self.cfg.no_replica_wait_ms > 0:
             deadline = (
                 time.monotonic() + self.cfg.no_replica_wait_ms / 1000.0
             )
             while a is None and time.monotonic() < deadline:
                 self._sleep(self._rng.uniform(0.01, 0.04))
-                a = self._launch(body, query, reason, exclude, done)
+                a = self._launch(body, query, reason, exclude, done, trace_id)
         return a
 
     def _launch(
         self, body: bytes, query: str, reason: str,
         exclude: Sequence[str], done: "queue.Queue[_Attempt]",
+        trace_id: Optional[str] = None,
     ) -> Optional[_Attempt]:
         r = self._pick(exclude)
         if r is None:
             return None
         a = _Attempt(r, reason)
         self.metrics.record_attempt(r.name, reason)
+        tr = self.tracer
+        traced = trace_id is not None and tr is not None and tr.enabled
+
+        def call() -> Response:
+            timeout_s = self.cfg.request_timeout_ms / 1000.0
+            if not traced:
+                # Untraced: exact pre-trace call shape, so fakes with the
+                # old predict signature keep working.
+                return r.client.predict(body, query, timeout_s, cancel=a.cancel)
+            # One 16-hex span id per ATTEMPT: it rides the traceparent
+            # header to the replica (whose serve_request records it as
+            # remote_parent) AND is recorded on the attempt span as
+            # span_hex — the two halves obs/merge.py joins on.
+            attempt_hex = new_span_hex()
+            with tr.bind(trace_id):
+                with tr.span(
+                    "router_attempt", replica=r.name, reason=reason,
+                    span_hex=attempt_hex,
+                ) as sp:
+                    resp = r.client.predict(
+                        body, query, timeout_s, cancel=a.cancel,
+                        traceparent=format_traceparent(trace_id, attempt_hex),
+                    )
+                    sp.set(status=resp[0], cancelled=a.cancel.is_set())
+                    return resp
 
         def run() -> None:
             ok: Optional[bool] = None
             try:
-                resp = r.client.predict(
-                    body, query, self.cfg.request_timeout_ms / 1000.0,
-                    cancel=a.cancel,
-                )
+                resp = call()
                 a.outcome = ("response", resp)
                 ok = resp[0] < 500
             except Exception as e:
@@ -968,14 +1047,22 @@ class FleetRouter:
                 r.queue_depth_interactive >= threshold for r in eligible
             )
 
-    def dispatch(self, body: bytes, query: str = "") -> Response:
+    def dispatch(
+        self, body: bytes, query: str = "",
+        trace_context: Optional[Tuple[str, Optional[str]]] = None,
+    ) -> Response:
         """Route one request; ALWAYS returns a response.  A 5xx here means
         every eligible replica (and every retry/hedge) failed — the
         client-visible failure the fleet soak requires to be zero.
         ``?priority=batch`` requests may additionally be SHED here (a
         policy 503, accounted separately from failures) when the fleet's
         interactive queues are saturated, and are never hedged — hedges
-        are a p99-tail spend reserved for interactive traffic."""
+        are a p99-tail spend reserved for interactive traffic.
+
+        ``trace_context`` is an optional (trace_id, parent span hex) pair
+        parsed from an inbound traceparent header — an external client's
+        trace continues through the fleet; without one a traced router
+        mints a fresh request trace id."""
         priority = _priority_of(query)
         if priority == "batch" and self._should_shed_batch():
             self.metrics.record_batch_shed()
@@ -985,16 +1072,35 @@ class FleetRouter:
                 "retry with backoff"
             )
         t0 = time.monotonic()
-        status, ctype, payload = self._dispatch_inner(body, query, priority)
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            trace_id, parent_hex = (
+                trace_context
+                if trace_context is not None
+                else (new_trace_id(), None)
+            )
+            with tr.bind(trace_id, parent_hex):
+                with tr.span("route_request", priority=priority) as sp:
+                    status, ctype, payload = self._dispatch_inner(
+                        body, query, priority, trace_id
+                    )
+                    sp.set(status=status)
+        else:
+            status, ctype, payload = self._dispatch_inner(
+                body, query, priority
+            )
         ok = status < 500
-        self.metrics.record_request(time.monotonic() - t0, ok)
+        latency_s = time.monotonic() - t0
+        self.metrics.record_request(latency_s, ok)
+        self.slo.observe(priority, latency_s, ok)
         return status, ctype, payload
 
     def _error(self, status: int, msg: str) -> Response:
         return status, "application/json", json.dumps({"error": msg}).encode()
 
     def _dispatch_inner(
-        self, body: bytes, query: str, priority: str = "interactive"
+        self, body: bytes, query: str, priority: str = "interactive",
+        trace_id: Optional[str] = None,
     ) -> Response:
         cfg = self.cfg
         done: "queue.Queue[_Attempt]" = queue.Queue()
@@ -1007,7 +1113,7 @@ class FleetRouter:
             else 0
         )
 
-        a = self._launch_waiting(body, query, "primary", tried, done)
+        a = self._launch_waiting(body, query, "primary", tried, done, trace_id)
         if a is None:
             self._log_event("no_replicas")
             return self._error(503, "no replicas available")
@@ -1023,7 +1129,7 @@ class FleetRouter:
                 # The tail case: nobody answered within hedge_ms — duplicate
                 # to another replica, first answer wins.
                 hedges_left -= 1
-                h = self._launch(body, query, "hedge", tried, done)
+                h = self._launch(body, query, "hedge", tried, done, trace_id)
                 if h is not None:
                     self.metrics.record_hedge()
                     attempts.append(h)
@@ -1068,14 +1174,14 @@ class FleetRouter:
                 delay = self._rng.uniform(0.0, ceiling)
                 if delay > 0:
                     self._sleep(delay)
-                nxt = self._launch(body, query, "retry", tried, done)
+                nxt = self._launch(body, query, "retry", tried, done, trace_id)
                 if nxt is None and pending == 0:
                     # With nothing pending this would fall through to an
                     # instant 503 — the same transient zero-eligible
                     # window the admission wait rides out (an untried
                     # replica readmitting mid-reload); wait for it too.
                     nxt = self._launch_waiting(
-                        body, query, "retry", tried, done
+                        body, query, "retry", tried, done, trace_id
                     )
                 if nxt is not None:
                     attempts.append(nxt)
@@ -1100,7 +1206,7 @@ class FleetRouter:
             for s in statuses
             if s["ready"] and not s["draining"] and s["healthy"]
         ]
-        return {
+        out = {
             "status": "ok" if ready else "unavailable",
             "replicas": len(statuses),
             "ready": len(ready),
@@ -1113,3 +1219,13 @@ class FleetRouter:
             ),
             "replica_status": statuses,
         }
+        if self.slo.enabled:
+            # Error budgets + burn rates on the fleet's ONE health
+            # endpoint (ISSUE 14 tentpole: the SLO layer is scrapeable
+            # where the operator already looks).
+            out["slo"] = self.slo.status()
+            out["slo_alerts"] = [
+                a for a in self.health.alerts
+                if str(a.get("alert", "")).startswith("slo_")
+            ]
+        return out

@@ -40,7 +40,11 @@ from ddlpc_tpu.obs.health import Alert as HealthAlert
 from ddlpc_tpu.obs.health import HealthMonitor
 from ddlpc_tpu.obs.http import render_metrics
 from ddlpc_tpu.obs.registry import MetricsRegistry
-from ddlpc_tpu.obs.tracing import Tracer
+from ddlpc_tpu.obs.tracing import (
+    TRACEPARENT_HEADER,
+    Tracer,
+    parse_traceparent,
+)
 from ddlpc_tpu.serve.batching import (
     DeadlineExceeded,
     EngineClosed,
@@ -72,11 +76,15 @@ class ServingFrontend:
         # exposition vs the legacy JSON snapshot), a span tracer for the
         # request path, and health detectors for queue saturation.
         self.registry = MetricsRegistry()
+        # Traces land next to the metrics stream: metrics_dir when set (the
+        # fleet gives each replica its own — N replicas must never
+        # interleave one serve_spans.jsonl), else the workdir as before.
+        trace_dir = self.cfg.metrics_dir or self.cfg.workdir
         self.tracer = Tracer(
             enabled=self.cfg.trace,
             service="serve",
-            jsonl_path=os.path.join(self.cfg.workdir, "serve_spans.jsonl"),
-            chrome_path=os.path.join(self.cfg.workdir, "serve_trace.json"),
+            jsonl_path=os.path.join(trace_dir, "serve_spans.jsonl"),
+            chrome_path=os.path.join(trace_dir, "serve_trace.json"),
         )
         self.metrics = ServeMetrics(
             window=self.cfg.metrics_window, registry=self.registry
@@ -177,6 +185,15 @@ class ServingFrontend:
             self.health.observe_queue(
                 self.batcher.queue_depth, self.cfg.queue_limit
             )
+            self._publish_slot_busy()
+
+    def _publish_slot_busy(self) -> None:
+        """Per-slot busy fractions over the emit window →
+        ``ddlpc_serve_slot_busy_fraction{slot}`` (continuous batcher only;
+        getattr-guarded like every other optional batcher surface)."""
+        fractions_fn = getattr(self.batcher, "slot_busy_fractions", None)
+        if fractions_fn is not None:
+            self.metrics.set_slot_busy(fractions_fn())
 
     # ---- request paths -----------------------------------------------------
 
@@ -618,12 +635,19 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": f"body is not a valid .npy: {e}"})
             return
         q = parse_qs(parsed.query)
+        # Cross-process trace context (ISSUE 14): a traceparent header from
+        # the fleet router binds this handler thread to the REQUEST's
+        # trace id, so serve_request and its children join the router's
+        # timeline.  Malformed/absent headers degrade to a local trace.
+        ctx = parse_traceparent(self.headers.get(TRACEPARENT_HEADER))
+        trace_id, parent_hex = ctx if ctx is not None else (None, None)
         try:
             overlap = float(q["overlap"][0]) if "overlap" in q else None
             priority = q["priority"][0] if "priority" in q else "interactive"
-            pred = self.frontend.predict_classes(
-                image, overlap=overlap, priority=priority
-            )
+            with self.frontend.tracer.bind(trace_id, parent_hex):
+                pred = self.frontend.predict_classes(
+                    image, overlap=overlap, priority=priority
+                )
         except Overloaded as e:
             self._send_json(503, {"error": str(e)}, extra=[("Retry-After", "1")])
         except (DeadlineExceeded, TimeoutError,

@@ -1,0 +1,159 @@
+"""Render a fleet's merged cross-process trace into the attribution table.
+
+Reads the per-process span streams a traced fleet leaves behind —
+``<fleet_dir>/router_spans.jsonl`` plus each replica's
+``<fleet_dir>/r<idx>/serve_spans.jsonl`` — stitches them on the shared
+request ``trace_id`` (``ddlpc_tpu/obs/merge.py``), and prints where each
+request's wall time went:
+
+    trace            total  status  att  router_wait  net_hop  queue  assembly  device  stitch  replica
+
+Columns are the ISSUE 14 attribution phases: router wait (admission →
+first dispatch), network hop (attempt minus replica serve time), replica
+queue (batcher admission → batch take), assembly (window plan + enqueue),
+device (jit_execute), stitch.  Batch spans serve several requests at
+once, so queue/device are attributed, not exclusive.
+
+Usage:
+    python scripts/fleet_report.py <fleet_dir>                # table
+    python scripts/fleet_report.py <fleet_dir> --trace-id af3…  # one request
+    python scripts/fleet_report.py <fleet_dir> --trace-out trace.json
+        # write the merged Perfetto-loadable timeline (optionally for one
+        # --trace-id)
+    python scripts/fleet_report.py <fleet_dir> --out report.json
+        # attribution rows + aggregate as a committed-artifact JSON
+    python scripts/fleet_report.py <fleet_dir> --jsonl fleet_trace.jsonl
+        # append the rows as flat kind="fleet_trace" records
+
+jax-free: runs anywhere the streams can be copied.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddlpc_tpu.obs import merge  # noqa: E402
+from ddlpc_tpu.obs.schema import stamp  # noqa: E402
+from ddlpc_tpu.utils.fsio import atomic_write_json  # noqa: E402
+
+
+def _fmt_ms(v) -> str:
+    return f"{v * 1000.0:8.1f}" if isinstance(v, (int, float)) else f"{'-':>8}"
+
+
+def render_table(rows: List[Dict[str, object]], out=sys.stdout) -> None:
+    header = (
+        f"{'trace':<16} {'total_ms':>8} {'status':>6} {'att':>3} "
+        f"{'r_wait':>8} {'net_hop':>8} {'queue':>8} {'assembly':>8} "
+        f"{'device':>8} {'stitch':>8}  replica"
+    )
+    print(header, file=out)
+    print("-" * len(header), file=out)
+    for r in rows:
+        print(
+            f"{str(r.get('trace_id', ''))[:16]:<16} "
+            f"{_fmt_ms(r.get('total_s'))} "
+            f"{str(r.get('status', '-')):>6} "
+            f"{r.get('attempts', 0):>3} "
+            f"{_fmt_ms(r.get('router_wait_s'))} "
+            f"{_fmt_ms(r.get('network_hop_s'))} "
+            f"{_fmt_ms(r.get('replica_queue_s'))} "
+            f"{_fmt_ms(r.get('assembly_s'))} "
+            f"{_fmt_ms(r.get('device_s'))} "
+            f"{_fmt_ms(r.get('stitch_s'))}  "
+            f"{r.get('winner_replica', '?')}"
+            f"{' (hedged)' if r.get('hedges') else ''}"
+            f"{' (retried)' if r.get('retries') else ''}",
+            file=out,
+        )
+
+
+def aggregate(rows: List[Dict[str, object]]) -> Dict[str, object]:
+    """Fleet-level attribution: mean seconds per phase + event counts."""
+    agg: Dict[str, object] = {"requests": len(rows)}
+    if not rows:
+        return agg
+    for key in (
+        "total_s", "router_wait_s", "network_hop_s", "replica_queue_s",
+        "assembly_s", "device_s", "stitch_s",
+    ):
+        vals = [
+            float(r[key]) for r in rows if isinstance(r.get(key), (int, float))
+        ]
+        if vals:
+            agg[f"mean_{key}"] = round(sum(vals) / len(vals), 6)
+    agg["retries"] = sum(int(r.get("retries", 0)) for r in rows)
+    agg["hedges"] = sum(int(r.get("hedges", 0)) for r in rows)
+    agg["max_processes"] = max(int(r.get("processes", 0)) for r in rows)
+    return agg
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("fleet_dir", help="fleet dir (router_spans.jsonl + r*/)")
+    ap.add_argument("--trace-id", default=None,
+                    help="restrict to one request's trace")
+    ap.add_argument("--trace-out", default=None,
+                    help="write the merged Perfetto trace.json here")
+    ap.add_argument("--out", default=None,
+                    help="write attribution rows + aggregate as JSON")
+    ap.add_argument("--jsonl", default=None,
+                    help="append rows as flat kind=fleet_trace records")
+    ap.add_argument("--limit", type=int, default=50,
+                    help="max table rows printed (0 = all)")
+    args = ap.parse_args(argv)
+
+    files = merge.fleet_span_files(args.fleet_dir)
+    if not files:
+        print(
+            f"fleet_report: no span streams under {args.fleet_dir} "
+            f"(was the fleet run with trace=true?)",
+            file=sys.stderr,
+        )
+        return 1
+    records = merge.read_spans(files)
+    if args.trace_id:
+        rows = [merge.attribution(records, args.trace_id)]
+    else:
+        rows = merge.summarize_requests(records)
+    if not rows:
+        print("fleet_report: no routed request traces found", file=sys.stderr)
+        return 1
+
+    shown = rows if not args.limit else rows[: args.limit]
+    render_table(shown, sys.stdout)
+    if len(shown) < len(rows):
+        print(f"... ({len(rows) - len(shown)} more; --limit 0 for all)")
+    agg = aggregate(rows)
+    print(
+        f"\n{agg['requests']} request(s), {agg.get('retries', 0)} retried, "
+        f"{agg.get('hedges', 0)} hedged, spans from "
+        f"{len(files)} stream(s)"
+    )
+
+    if args.trace_out:
+        doc = merge.build_timeline(records, trace_id=args.trace_id)
+        merge.write_trace(doc, args.trace_out)
+        print(f"fleet_report: merged timeline -> {args.trace_out}")
+    if args.out:
+        atomic_write_json(
+            args.out,
+            {"source_files": files, "aggregate": agg, "requests": rows},
+        )
+        print(f"fleet_report: report -> {args.out}")
+    if args.jsonl:
+        with open(args.jsonl, "a") as f:
+            for r in rows:
+                f.write(json.dumps(stamp(dict(r), kind="fleet_trace")) + "\n")
+        print(f"fleet_report: {len(rows)} record(s) -> {args.jsonl}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,6 +6,11 @@ object per line with a ``schema`` field (ddlpc_tpu/obs/schema.py), so one
 tool tails any of them.  Give it files or a run workdir (tails every
 ``*.jsonl`` in it).
 
+Multiple files (or a whole run/fleet dir) are MERGED on each record's
+``time`` field — a fleet's router + replica streams tail as one
+chronological story (``obs_tail.py fleet/router.jsonl fleet/r0/... -f``);
+in follow mode the merge holds within each poll sweep.
+
 Usage:
     python scripts/obs_tail.py runs/flagship                  # whole run dir
     python scripts/obs_tail.py runs/x/spans.jsonl -f          # follow
@@ -116,6 +121,23 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     handles: Dict[str, TextIO] = {}
     stale_noted: set = set()
+    # Multi-stream MERGE (ISSUE 14 satellite): records from every file are
+    # interleaved on their `time` field, so a fleet's router + replica
+    # streams read as one chronological story instead of N blocks.
+    # Records without a timestamp sort where their file position left them
+    # (stable sort, key falls back to the previous seen time per file).
+    # Timestampless records sort at their file's last seen time (stable
+    # sort keeps file order among them) — ONE rule, initial dump and
+    # follow sweeps alike.
+    last_t: Dict[str, float] = {}
+
+    def sort_key(path: str, rec: dict) -> float:
+        t = rec.get("time")
+        if isinstance(t, (int, float)) and not isinstance(t, bool):
+            last_t[path] = float(t)
+        return last_t.get(path, 0.0)
+
+    initial: List[tuple] = []
     for path in files:
         try:
             fh = open(path, "r")
@@ -134,8 +156,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                 continue
             _note_stale(rec, src, stale_noted)
             if _match(rec, kinds, where):
-                _emit(rec, src, keys, sys.stdout)
+                initial.append((sort_key(path, rec), src, rec))
         handles[path] = fh
+    initial.sort(key=lambda item: item[0])
+    for _, src, rec in initial:
+        _emit(rec, src, keys, sys.stdout)
 
     if not args.follow:
         for fh in handles.values():
@@ -144,7 +169,10 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     try:
         while True:
-            idle = True
+            # One sweep gathers every file's new records, then emits the
+            # batch time-ordered — follow mode keeps the merged ordering
+            # within each poll window.
+            batch: List[tuple] = []
             for path, fh in handles.items():
                 while True:
                     pos = fh.tell()
@@ -157,15 +185,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                         # on the next poll.
                         fh.seek(pos)
                         break
-                    idle = False
                     try:
                         rec = json.loads(line)
                     except json.JSONDecodeError:
                         continue
-                    _note_stale(rec, os.path.basename(path), stale_noted)
+                    src = os.path.basename(path)
+                    _note_stale(rec, src, stale_noted)
                     if _match(rec, kinds, where):
-                        _emit(rec, os.path.basename(path), keys, sys.stdout)
-            if idle:
+                        batch.append((sort_key(path, rec), src, rec))
+            if batch:
+                batch.sort(key=lambda item: item[0])
+                for _, src, rec in batch:
+                    _emit(rec, src, keys, sys.stdout)
+            else:
                 time.sleep(0.25)
     except KeyboardInterrupt:
         return 0

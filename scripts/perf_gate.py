@@ -89,6 +89,67 @@ GATED = {
 # --------------------------------------------------------------------------
 
 
+def host_fingerprint() -> Dict[str, object]:
+    """What the baseline's numbers were measured ON.  Compared (not
+    hashed) so a mismatch warning can say WHICH dimension moved."""
+    import platform
+    import socket
+
+    return {
+        "hostname": socket.gethostname(),
+        "machine": platform.machine(),
+        "host_cores": os.cpu_count(),
+    }
+
+
+def baseline_warnings(
+    baseline: dict, max_age_days: float,
+    now: Optional[float] = None,
+    current_host: Optional[Dict[str, object]] = None,
+) -> List[str]:
+    """Staleness/provenance warnings for a loaded baseline (ISSUE 14
+    satellite).  NON-FATAL by design — the gate still compares — but loud:
+    with the driver bench unreachable this gate is the only live
+    regression signal, and a silently stale or foreign-host baseline
+    would hold the wrong bands with a straight face."""
+    warnings: List[str] = []
+    now = time.time() if now is None else now
+    host = current_host if current_host is not None else host_fingerprint()
+    generated_at = baseline.get("generated_at")
+    if not isinstance(generated_at, (int, float)) or isinstance(
+        generated_at, bool
+    ):
+        warnings.append(
+            "baseline has no generated_at stamp (predates age tracking) — "
+            "regenerate with --update-baseline to arm staleness checks"
+        )
+    else:
+        age_days = (now - float(generated_at)) / 86400.0
+        if age_days > max_age_days:
+            warnings.append(
+                f"baseline is {age_days:.1f} days old (> {max_age_days:g}) "
+                f"— its tolerance bands may no longer describe this tree; "
+                f"regenerate with --update-baseline"
+            )
+    recorded = baseline.get("host")
+    if not isinstance(recorded, dict):
+        warnings.append(
+            "baseline has no host fingerprint — cannot verify it was "
+            "measured on THIS host; regenerate with --update-baseline"
+        )
+    else:
+        for key, current in host.items():
+            stamped = recorded.get(key)
+            if stamped is not None and stamped != current:
+                warnings.append(
+                    f"baseline was measured on a different host "
+                    f"({key}: baseline {stamped!r} vs this host "
+                    f"{current!r}) — baselines are host-bound; regenerate "
+                    f"with --update-baseline"
+                )
+    return warnings
+
+
 def validate_baseline(obj: object) -> List[str]:
     """Schema errors for a decoded baseline document (empty = valid)."""
     errs: List[str] = []
@@ -148,12 +209,15 @@ def compare(
     return failures
 
 
-def smoke(baseline_path: str) -> int:
+def smoke(baseline_path: str, max_age_days: float = 30.0) -> int:
     """Validate the committed baseline + self-check the gate logic.
 
     No measurement, no jax import — cheap enough for tier-1.  Fails (1)
     if the baseline is missing/invalid or if a synthetic regression of
     2× tolerance on any gated metric slips through the comparator.
+    Staleness/foreign-host findings print as warnings (the tier-1 run
+    must not start failing merely because a month passed — but it must
+    SAY so on every run until the baseline is regenerated).
     """
     try:
         with open(baseline_path) as f:
@@ -166,6 +230,8 @@ def smoke(baseline_path: str) -> int:
         for e in errs:
             print(f"perf_gate --smoke: {e}")
         return 1
+    for w in baseline_warnings(baseline, max_age_days):
+        print(f"perf_gate --smoke: WARNING: {w}", file=sys.stderr)
     metrics = baseline["metrics"]
     clean = {n: float(s["value"]) for n, s in metrics.items()}
     if compare(metrics, clean):
@@ -424,6 +490,16 @@ def build_baseline(measured: Dict[str, float]) -> dict:
     return {
         "schema": BASELINE_SCHEMA,
         "generated_by": "scripts/perf_gate.py --update-baseline",
+        # Age + host provenance (ISSUE 14 satellite): the gate warns
+        # loudly when the baseline outlives max-baseline-age-days or is
+        # replayed on a different host — with the driver bench
+        # unreachable, this gate is the only live regression signal and
+        # its baseline must not silently go stale.
+        "generated_at": time.time(),
+        "generated_at_iso": time.strftime(
+            "%Y-%m-%dT%H:%M:%S%z", time.localtime()
+        ),
+        "host": host_fingerprint(),
         "env": {
             "backend": jax.default_backend(),
             "devices": len(jax.devices()),
@@ -448,6 +524,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="validate baseline + gate logic, no measurement")
     ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--max-baseline-age-days", type=float, default=30.0,
+                    help="warn (loudly, non-fatally) when the baseline's "
+                    "generated_at stamp is older than this")
     ap.add_argument("--devices", type=int, default=0,
                     help="force an N-device virtual CPU mesh (0 = as-is)")
     ap.add_argument("--skip-step", action="store_true")
@@ -467,7 +546,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
 
     if args.smoke:
-        return smoke(args.baseline)
+        return smoke(args.baseline, args.max_baseline_age_days)
 
     inject: Dict[str, float] = {}
     for spec in args.inject:
@@ -539,6 +618,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         for e in errs:
             print(f"perf_gate: {e}", file=sys.stderr)
         return 2
+    for w in baseline_warnings(baseline, args.max_baseline_age_days):
+        print(f"perf_gate: WARNING: {w}", file=sys.stderr)
     failures = compare(baseline["metrics"], measured, inject=inject)
     for fail in failures:
         print(f"perf_gate: {fail}")

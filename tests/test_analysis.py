@@ -360,11 +360,14 @@ def test_injected_unstamped_jsonl_write_fails(tmp_path, capsys):
 
 
 def test_injected_undocumented_metric_fails(tmp_path, capsys):
+    # ddlpc_router_* is a fully static family (ddlpc_fleet_* gained a
+    # documented dynamic prefix for the aggregator's rollups, which
+    # exempts its doc-side direction by design).
     dst = _copy_pkg(tmp_path)
-    fleet = dst / "ddlpc_tpu" / "serve" / "fleet.py"
-    fleet.write_text(
-        fleet.read_text().replace(
-            '"ddlpc_fleet_restarts_total"', '"ddlpc_fleet_bogus_total"', 1
+    router = dst / "ddlpc_tpu" / "serve" / "router.py"
+    router.write_text(
+        router.read_text().replace(
+            '"ddlpc_router_drains_total"', '"ddlpc_router_bogus_total"', 1
         )
     )
     cli = _load_cli()
@@ -373,8 +376,8 @@ def test_injected_undocumented_metric_fails(tmp_path, capsys):
     assert rc == 1
     # both directions fail: the bogus name is undocumented AND the
     # documented real name no longer has an emitter
-    assert "ddlpc_fleet_bogus_total" in out and "fleet.py" in out
-    assert "ddlpc_fleet_restarts_total" in out
+    assert "ddlpc_router_bogus_total" in out and "router.py" in out
+    assert "ddlpc_router_drains_total" in out
     assert "[metric-doc]" in out
 
 

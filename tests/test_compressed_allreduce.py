@@ -57,7 +57,15 @@ def test_wire_dtype_selection():
         wire_dtype(1000, 100)  # 4-byte hops = zero compression: refuse
 
 
-@pytest.mark.parametrize("mode", ["int8", "float16"])
+@pytest.mark.parametrize(
+    "mode",
+    [
+        "int8",
+        # int8 stays the fast codec-bound arm (the lossier lattice);
+        # float16 keeps full coverage in the slow tier (budget maintenance)
+        pytest.param("float16", marks=pytest.mark.slow),
+    ],
+)
 def test_ring_mean_within_codec_bound(mode):
     cfg = CompressionConfig(mode=mode, transport="ring")
     rng = np.random.default_rng(0)
@@ -106,7 +114,16 @@ def test_ring_mode_none_is_exact_pmean():
     )
 
 
-@pytest.mark.parametrize("n", [2, 3, 8])
+@pytest.mark.parametrize(
+    "n",
+    [
+        2,
+        3,
+        # n=8 costs ~18 s on the 2-core CI host for the same ring-walk
+        # property sizes 2/3 pin fast (budget maintenance)
+        pytest.param(8, marks=pytest.mark.slow),
+    ],
+)
 def test_ring_sizes(n):
     """The ring index arithmetic must hold for any axis size, including odd."""
     cfg = CompressionConfig(mode="int8", transport="ring")

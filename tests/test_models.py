@@ -118,7 +118,10 @@ def test_unet_s2d_stem_shapes():
         # Factor 2 is slow-only: factor 4 (kept in tier-1) is the flagship
         # operating point and exercises the identical stem/head code path.
         pytest.param(2, marks=pytest.mark.slow),
-        4,
+        # tier-1's fast stem-learn representative is now
+        # test_unetpp_s2d_stem_learns (budget maintenance); the unet
+        # variant keeps full coverage in the slow tier
+        pytest.param(4, marks=pytest.mark.slow),
     ],
 )
 def test_unet_s2d_stem_learns(tmp_path, stem_factor):
@@ -146,6 +149,9 @@ def test_unet_s2d_stem_learns(tmp_path, stem_factor):
     assert rec["val_miou"] > 0.5
 
 
+@pytest.mark.slow  # tier-1 keeps test_unet_detail_head_learns, which
+# trains the same recipe WITH head_dtype="bfloat16" — the bf16 head
+# storage path keeps a fast learn test through it (budget maintenance)
 def test_bf16_head_learns(tmp_path):
     """head_dtype='bfloat16' (the bench configs' setting — it halves the
     logit head's HBM traffic) must train to the same place as the fp32

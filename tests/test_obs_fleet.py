@@ -1,0 +1,608 @@
+"""Fleet observability (ISSUE 14): trace-context propagation across the
+router→replica HTTP hop, the per-process stream merge, telemetry
+aggregation rollups, SLO burn-rate alerting, slot-utilization gauges, and
+the obs_tail/perf_gate satellites.
+
+The e2e test drives a REAL HTTP request path — a FleetRouter with
+``HTTPReplicaClient``s against two live ``serve.server`` frontends (fake
+numpy engine, no jax) — and asserts one trace_id spans all three
+processes' streams, hedge loser included."""
+
+import io
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+from ddlpc_tpu.config import FleetConfig, ServeConfig
+from ddlpc_tpu.obs import merge
+from ddlpc_tpu.obs.aggregate import TelemetryAggregator, parse_exposition
+from ddlpc_tpu.obs.health import BurnRateLatch, HealthMonitor, SLOTracker
+from ddlpc_tpu.obs.registry import MetricsRegistry
+from ddlpc_tpu.obs.schema import check_record
+from ddlpc_tpu.obs.tracing import (
+    Tracer,
+    format_traceparent,
+    new_span_hex,
+    new_trace_id,
+    parse_traceparent,
+)
+from ddlpc_tpu.serve.cbatch import ContinuousBatcher
+from ddlpc_tpu.serve.router import FleetRouter, HTTPReplicaClient
+from ddlpc_tpu.serve.server import ServingFrontend, make_server
+
+TILE = (32, 32)
+NCLASS = 4
+
+
+# ---- trace context helpers --------------------------------------------------
+
+
+def test_traceparent_roundtrip():
+    t, s = new_trace_id(), new_span_hex()
+    assert len(t) == 32 and len(s) == 16
+    assert parse_traceparent(format_traceparent(t, s)) == (t, s)
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        None, "", "garbage", "00-short-short-01",
+        "00-" + "0" * 32 + "-" + "a" * 16 + "-01",  # all-zero trace id
+        "00-" + "g" * 32 + "-" + "a" * 16 + "-01",  # non-hex
+        "00-" + "a" * 32 + "-" + "b" * 16,  # 3 parts
+        "00-" + "A" * 32 + "-" + "b" * 16 + "-01",  # uppercase (W3C: lower)
+        "00-+" + "a" * 31 + "-" + "b" * 16 + "-01",  # int()-parseable sign
+        "00-" + "a" * 15 + "_" + "a" * 16 + "-" + "b" * 16 + "-01",
+    ],
+)
+def test_traceparent_malformed_degrades_to_none(bad):
+    assert parse_traceparent(bad) is None
+
+
+def test_tracer_bind_stamps_trace_id_and_remote_parent(tmp_path):
+    path = str(tmp_path / "s.jsonl")
+    tr = Tracer(enabled=True, service="t", jsonl_path=path)
+    trace_id, parent = "f" * 32, "b" * 16
+    with tr.bind(trace_id, parent):
+        with tr.span("root"):
+            with tr.span("child"):
+                pass
+    with tr.span("outside"):
+        pass
+    tr.close()
+    recs = {r["name"]: r for r in map(json.loads, open(path))}
+    assert recs["root"]["trace_id"] == trace_id
+    assert recs["root"]["remote_parent"] == parent
+    assert recs["child"]["trace_id"] == trace_id
+    assert "remote_parent" not in recs["child"]  # has a LOCAL parent
+    assert recs["outside"]["trace_id"] == tr.trace_id  # run id, unbound
+    assert all(r["pid"] == os.getpid() for r in recs.values())
+    assert all(not check_record(r) for r in recs.values())
+
+
+def test_tracer_bind_disabled_is_noop():
+    tr = Tracer(enabled=False)
+    with tr.bind("f" * 32, None):
+        assert tr.current_trace_id() is None
+
+
+def test_batcher_spans_carry_request_trace_ids(tmp_path):
+    path = str(tmp_path / "s.jsonl")
+    tr = Tracer(enabled=True, service="serve", jsonl_path=path)
+    b = ContinuousBatcher(
+        lambda xs: [x * 2 for x in xs], max_batch=8, slots=1,
+        tracer=tr, start=False,
+    )
+    tid = new_trace_id()
+    with tr.bind(tid):
+        futs = b.submit_many([1, 2, 3])
+    b.start()
+    assert [f.result(timeout=5) for f in futs] == [2, 4, 6]
+    b.close()
+    tr.close()
+    recs = [json.loads(l) for l in open(path)]
+    batch_spans = [r for r in recs if r["name"] in ("batch_coalesce",
+                                                    "jit_execute")]
+    assert batch_spans
+    for r in batch_spans:
+        assert r["trace_ids"] == [tid]
+        assert not check_record(r)
+
+
+# ---- e2e: HTTP through router + 2 replicas, hedge loser included ------------
+
+
+class FakeEngine:
+    """numpy-only engine standing in for InferenceEngine: enough surface
+    for ServingFrontend + server.py, with a per-instance forward delay so
+    one replica can be made slow (the hedge trigger)."""
+
+    def __init__(self, delay_s: float = 0.0):
+        self.delay_s = delay_s
+        self.tile = TILE
+        self.channels = 3
+        self.version = 0
+        self.checkpoint_step = 1
+        self.compiled_shapes = []
+        self.quantize_mode = "off"
+
+    def forward_windows(self, windows):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return [
+            np.zeros((TILE[0], TILE[1], NCLASS), np.float32) for _ in windows
+        ]
+
+
+def _serve_replica(tmp_path, name, delay_s):
+    home = tmp_path / name
+    home.mkdir()
+    cfg = ServeConfig(
+        workdir=str(tmp_path), metrics_dir=str(home), max_batch=4,
+        deadline_ms=0.0, metrics_every_s=0.0, trace=True, slots=1,
+    )
+    frontend = ServingFrontend(FakeEngine(delay_s), cfg)
+    server = make_server(frontend, "127.0.0.1", 0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, frontend, thread
+
+
+def test_e2e_trace_propagation_with_hedge(tmp_path):
+    """One HTTP request through router + 2 live replicas: the slow
+    primary forces a hedge; the merged trace carries ONE trace_id across
+    all three processes' spans — the hedge loser's serve_request
+    included — with flow links router_attempt → serve_request."""
+    r_slow = _serve_replica(tmp_path, "r0", delay_s=0.8)
+    r_fast = _serve_replica(tmp_path, "r1", delay_s=0.0)
+    # In-process test: every tracer records the same OS pid, so give each
+    # replica a distinct one — what N real processes would have.
+    r_slow[1].tracer._pid = 90001
+    r_fast[1].tracer._pid = 90002
+    router_spans = str(tmp_path / "router_spans.jsonl")
+    tracer = Tracer(enabled=True, service="router", jsonl_path=router_spans)
+    cfg = FleetConfig(
+        replicas=2, hedge_ms=150.0, retries=1, request_timeout_ms=8000.0,
+        scrape_every_s=0.0, metrics_every_s=0.0, no_replica_wait_ms=0.0,
+    )
+    router = FleetRouter(cfg, tracer=tracer)
+    try:
+        for (server, _, _), name in ((r_slow, "r0"), (r_fast, "r1")):
+            port = server.server_address[1]
+            router.add_replica(name, HTTPReplicaClient(name, "127.0.0.1", port))
+        # Deterministic hedge: bias the fast replica's scraped load so the
+        # primary attempt lands on the SLOW one (the hedge pick excludes
+        # already-tried replicas, so the hedge goes to the fast one).
+        with router._lock:
+            router._replicas["r1"].queue_depth = 8
+        buf = io.BytesIO()
+        np.save(buf, np.zeros((32, 32, 3), np.float32), allow_pickle=False)
+        status, _, payload = router.dispatch(buf.getvalue())
+        assert status == 200
+        snap = router.metrics.snapshot()
+        assert snap["hedges"] == 1 and snap["hedge_wins"] == 1
+        time.sleep(1.0)  # the loser's delayed forward must land its spans
+    finally:
+        for server, frontend, thread in (r_slow, r_fast):
+            server.shutdown()
+            frontend.close()
+            server.server_close()
+            thread.join(timeout=5)
+        tracer.close()
+
+    files = [
+        router_spans,
+        str(tmp_path / "r0" / "serve_spans.jsonl"),
+        str(tmp_path / "r1" / "serve_spans.jsonl"),
+    ]
+    assert all(os.path.exists(f) for f in files)
+    records = merge.read_spans(files)
+    routed = merge.trace_ids(records)
+    assert len(routed) == 1
+    tid = routed[0]
+    request = merge.filter_trace(records, tid)
+    # One trace id spanning all three processes.
+    services = {r["service"] for r in request}
+    assert services == {"router", "serve"}
+    pids = {(r["service"], r["pid"]) for r in request}
+    assert len(pids) == 3
+    # Both replicas executed the request (hedge loser included): two
+    # serve_request roots, each remote-parented to a distinct attempt.
+    serves = [r for r in request if r["name"] == "serve_request"]
+    attempts = [r for r in request if r["name"] == "router_attempt"]
+    assert len(serves) == 2 and len(attempts) == 2
+    assert {a["reason"] for a in attempts} == {"primary", "hedge"}
+    hexes = {a["span_hex"] for a in attempts}
+    assert {s["remote_parent"] for s in serves} == hexes
+    # The merged timeline: 3 process tracks + 2 flow arrows.
+    doc = merge.build_timeline(records, trace_id=tid)
+    assert doc["metadata"]["processes"] == 3
+    flows = [e for e in doc["traceEvents"] if e.get("ph") in ("s", "f")]
+    assert len(flows) == 4  # 2 hops x (start + finish)
+    json.dumps(doc)  # Perfetto loads JSON — it must BE json
+    # Attribution: the hedge won, phases populated.
+    row = merge.attribution(records, tid)
+    assert row["hedges"] == 1 and row["winner_reason"] == "hedge"
+    assert row["total_s"] > 0 and row["device_s"] > 0
+    assert not check_record({**row, "schema": 1})
+    # Every span record on every stream stays schema-clean.
+    assert all(not check_record(r) for r in records if "_src" in r)
+
+
+# ---- telemetry aggregation --------------------------------------------------
+
+
+def _regs():
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    for i, r in enumerate((r1, r2)):
+        r.counter("ddlpc_serve_requests_total", "reqs").inc(10 * (i + 1))
+        r.gauge("ddlpc_serve_queue_depth", "depth").set(5 * (i + 1))
+        h = r.histogram("ddlpc_serve_request_latency_seconds", "lat")
+        h.observe(0.01)
+        h.observe(0.2 * (i + 1))
+    return r1, r2
+
+
+def test_aggregator_counter_sum_gauge_max_histogram_merge():
+    r1, r2 = _regs()
+    agg = TelemetryAggregator(stale_after_s=60.0)
+    agg.add_source("r0", r1.exposition)
+    agg.add_source("r1", r2.exposition)
+    assert agg.scrape_once() == {"r0": True, "r1": True}
+    text = agg.exposition()
+    rollups = {}
+    per_replica = []
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        if 'replica="fleet"' in name:
+            rollups[name] = float(value)
+        elif "replica=" in name:
+            per_replica.append(name)
+    assert rollups['ddlpc_fleet_serve_requests_total{replica="fleet"}'] == 30
+    assert rollups['ddlpc_fleet_serve_queue_depth{replica="fleet"}'] == 10  # max
+    assert (
+        rollups[
+            'ddlpc_fleet_serve_request_latency_seconds_count'
+            '{replica="fleet"}'
+        ]
+        == 4
+    )
+    # bucket merge: cumulative counts summed per le
+    assert (
+        rollups[
+            'ddlpc_fleet_serve_request_latency_seconds_bucket'
+            '{le="0.01",replica="fleet"}'
+        ]
+        == 2
+    )
+    # per-replica series preserved
+    assert any('replica="r0"' in n for n in per_replica)
+    assert any('replica="r1"' in n for n in per_replica)
+    # round-trips through its own parser
+    assert "ddlpc_fleet_serve_requests_total" in parse_exposition(text)
+
+
+def test_aggregator_dead_replica_goes_stale_and_leaves_gauge_rollup():
+    clock = [0.0]
+    r1, r2 = _regs()
+    agg = TelemetryAggregator(stale_after_s=5.0, clock=lambda: clock[0])
+    agg.add_source("r0", r1.exposition)
+    dead = {"fail": False}
+
+    def r2_fetch():
+        if dead["fail"]:
+            raise ConnectionError("replica gone")
+        return r2.exposition()
+
+    agg.add_source("r1", r2_fetch)
+    agg.scrape_once()
+    snap = agg.snapshot()
+    assert snap["ddlpc_fleet_serve_requests_total"] == 30
+    assert snap["ddlpc_fleet_serve_queue_depth"] == 10  # max of 5, 10
+    assert snap["ddlpc_fleet_sources_fresh"] == 2
+    # r1 dies; r0 re-scrapes fine.  Past stale_after_s the stale flag
+    # raises and r1's GAUGES leave the rollup (frozen queue depth must
+    # not pose as the fleet's worst) — but its COUNTERS keep
+    # contributing their last cumulative values: the fleet's
+    # work-done total must stay monotonic or rate() reads a reset.
+    dead["fail"] = True
+    clock[0] = 10.0
+    assert agg.scrape_once() == {"r0": True, "r1": False}
+    snap = agg.snapshot()
+    assert snap["ddlpc_fleet_serve_requests_total"] == 30  # monotonic
+    assert snap["ddlpc_fleet_serve_queue_depth"] == 5  # r1's gauge gone
+    assert snap["ddlpc_fleet_sources_fresh"] == 1
+    text = agg.exposition()
+    assert 'ddlpc_fleet_source_stale{replica="r1"} 1' in text
+    assert 'ddlpc_fleet_source_stale{replica="r0"} 0' in text
+    # the dead replica's LAST per-replica series stay visible
+    assert 'ddlpc_fleet_serve_requests_total{replica="r1"} 20' in text
+
+
+def test_aggregator_counter_rollup_monotonic_across_replica_restart():
+    """The supervised lifecycle — remove_source at death, fresh
+    add_source at readiness with counters back at zero — must never walk
+    a fleet counter rollup backwards (a dip reads as a counter reset to
+    rate())."""
+    r1, r2 = _regs()  # r0: 10 requests, r1: 20 requests
+    agg = TelemetryAggregator(stale_after_s=60.0)
+    agg.add_source("r0", r1.exposition)
+    agg.add_source("r1", r2.exposition)
+    agg.scrape_once()
+    assert agg.snapshot()["ddlpc_fleet_serve_requests_total"] == 30
+    # r1 crashes: its 20 served requests are retired, not forgotten.
+    agg.remove_source("r1")
+    assert agg.snapshot()["ddlpc_fleet_serve_requests_total"] == 30
+    # ...and its fresh incarnation starts counting from zero on top.
+    r2b = MetricsRegistry()
+    r2b.counter("ddlpc_serve_requests_total", "reqs").inc(3)
+    agg.add_source("r1", r2b.exposition)
+    agg.scrape_once()
+    snap = agg.snapshot()
+    assert snap["ddlpc_fleet_serve_requests_total"] == 33
+    # gauges carry NO retirement: only live sources compete for the max
+    assert snap["ddlpc_fleet_serve_queue_depth"] == 5
+    # counter families expose as untyped (a federation shape, not a
+    # native counter), gauges stay gauges
+    text = agg.exposition()
+    assert "# TYPE ddlpc_fleet_serve_requests_total untyped" in text
+    assert "# TYPE ddlpc_fleet_serve_queue_depth gauge" in text
+
+
+def test_aggregator_renames_preexisting_replica_label():
+    """A source family that ALREADY carries a `replica` label (the
+    router's ddlpc_router_* series) must not gain a second label with the
+    same name — the text format forbids it; the original renames to
+    src_replica and the aggregator's own replica label stays uniform."""
+    r = MetricsRegistry()
+    c = r.counter("ddlpc_router_attempts_total", "att",
+                  labelnames=("replica", "reason"))
+    c.inc(replica="r0", reason="primary")
+    c.inc(replica="r1", reason="primary")
+    agg = TelemetryAggregator(stale_after_s=60.0)
+    agg.add_source("router", r.exposition)
+    agg.scrape_once()
+    text = agg.exposition()
+    series = [
+        l for l in text.splitlines()
+        if l.startswith("ddlpc_fleet_router_attempts_total{")
+    ]
+    assert series
+    for line in series:
+        assert line.count("replica=") == line.count("src_replica=") + 1
+    assert (
+        'ddlpc_fleet_router_attempts_total{src_replica="r0",'
+        'reason="primary",replica="router"} 1' in text
+    )
+    # the rollup aggregates across SOURCES per original label-set
+    assert (
+        'ddlpc_fleet_router_attempts_total{src_replica="r0",'
+        'reason="primary",replica="fleet"} 1' in text
+    )
+    # JSON snapshot renders multi-label keys as ONE brace group
+    snap = agg.snapshot()
+    assert (
+        'ddlpc_fleet_router_attempts_total'
+        '{src_replica="r0",reason="primary"}' in snap
+    )
+
+
+def test_fleet_metrics_endpoint_includes_rollups(tmp_path):
+    """The fleet /metrics handler concatenates router exposition +
+    aggregator rollups under one text scrape."""
+    from ddlpc_tpu.serve.fleet import make_fleet_server
+
+    r1, _ = _regs()
+    agg = TelemetryAggregator(stale_after_s=60.0)
+    agg.add_source("r0", r1.exposition)
+    agg.scrape_once()
+    router = FleetRouter(FleetConfig(scrape_every_s=0.0, metrics_every_s=0.0))
+    server = make_fleet_server(router, None, "127.0.0.1", 0, aggregator=agg)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        import http.client
+
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", server.server_address[1], timeout=10
+        )
+        conn.request("GET", "/metrics", headers={"Accept": "text/plain"})
+        resp = conn.getresponse()
+        text = resp.read().decode()
+        conn.close()
+        assert resp.status == 200
+        assert "ddlpc_router_requests_total" in text  # router's own
+        assert (
+            'ddlpc_fleet_serve_requests_total{replica="fleet"} 10' in text
+        )
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=5)
+
+
+# ---- SLO burn-rate alerting -------------------------------------------------
+
+
+def _slo(clock, monitor=None, registry=None):
+    return SLOTracker(
+        {"interactive": 0.2, "batch": 2.0},
+        availability=0.99,
+        budget_window_s=100.0,
+        windows=[("fast", 10.0, 5.0, "critical"),
+                 ("slow", 50.0, 1.5, "warn")],
+        min_requests=5,
+        monitor=monitor,
+        registry=registry,
+        clock=clock,
+    )
+
+
+def test_burn_rate_alert_fires_latches_and_rearms():
+    t = [0.0]
+    mon = HealthMonitor(service="router")
+    slo = _slo(lambda: t[0], monitor=mon)
+    for _ in range(20):
+        t[0] += 0.1
+        slo.observe("interactive", 0.01, True)
+    assert slo.check() == []
+    assert slo.error_budget_remaining("interactive") == 1.0
+    # Error burst: every request bad → fast burn 100x >> 5x threshold.
+    for _ in range(20):
+        t[0] += 0.1
+        slo.observe("interactive", 0.01, False)
+    fired = slo.check()
+    assert [a.alert for a in fired] == ["slo_burn_fast", "slo_burn_slow"]
+    assert fired[0].severity == "critical"
+    assert any(a["alert"] == "slo_burn_fast" for a in mon.alerts)
+    # Latched: the same excursion does not re-alert.
+    assert slo.check() == []
+    # Recovery rolls the errors out of the fast window → re-arm → a new
+    # burst alerts again.
+    t[0] += 15.0
+    for _ in range(20):
+        t[0] += 0.1
+        slo.observe("interactive", 0.01, True)
+    assert not any(a.alert == "slo_burn_fast" for a in slo.check())
+    for _ in range(20):
+        t[0] += 0.1
+        slo.observe("interactive", 0.01, False)
+    assert any(a.alert == "slo_burn_fast" for a in slo.check())
+
+
+def test_slo_latency_objective_counts_slow_requests_as_bad():
+    t = [0.0]
+    slo = _slo(lambda: t[0])
+    for _ in range(10):
+        t[0] += 0.1
+        slo.observe("interactive", 5.0, True)  # 5s >> 200ms objective
+    status = slo.status()
+    assert status["interactive_bad"] == 10
+    assert status["interactive_error_budget_remaining"] < 0
+    assert not check_record({**status, "schema": 1})
+
+
+def test_slo_quiet_below_min_requests():
+    t = [0.0]
+    slo = _slo(lambda: t[0])
+    for _ in range(3):  # < min_requests: too little traffic to page on
+        t[0] += 0.1
+        slo.observe("interactive", 0.01, False)
+    assert slo.check() == []
+
+
+def test_slo_status_rides_router_healthz_and_emit(tmp_path):
+    class Logger:
+        def __init__(self):
+            self.records = []
+
+        def log(self, rec, echo=False):
+            self.records.append(dict(rec))
+
+    logger = Logger()
+    router = FleetRouter(
+        FleetConfig(scrape_every_s=0.0, metrics_every_s=0.0),
+        logger=logger,
+    )
+    router.emit()
+    kinds = [r.get("kind") for r in logger.records]
+    assert "slo" in kinds and "router" in kinds
+    h = router.healthz()
+    assert "slo" in h and "availability_objective" in h["slo"]
+
+
+def test_burn_rate_latch_validates():
+    with pytest.raises(ValueError):
+        BurnRateLatch("x", 10.0, 0.0, "warn")
+    with pytest.raises(ValueError):
+        SLOTracker({"interactive": 1.0}, availability=1.0)
+
+
+# ---- slot utilization gauge -------------------------------------------------
+
+
+def test_slot_busy_fraction_tracks_busy_and_idle_slots():
+    release = threading.Event()
+
+    def forward(xs):
+        release.wait(5.0)
+        return xs
+
+    reg = MetricsRegistry()
+    from ddlpc_tpu.serve.metrics import ServeMetrics
+
+    metrics = ServeMetrics(registry=reg)
+    b = ContinuousBatcher(forward, max_batch=1, slots=2, metrics=metrics)
+    b.slot_busy_fractions()  # reset marks
+    fut = b.submit(1)
+    time.sleep(0.25)
+    fractions = b.slot_busy_fractions()
+    busy = sorted(fractions.values())
+    assert len(fractions) == 2
+    assert busy[0] < 0.3  # the idle slot
+    assert busy[1] > 0.7  # the one stuck in forward
+    release.set()
+    fut.result(timeout=5)
+    metrics.set_slot_busy(fractions)
+    expo = reg.exposition()
+    assert "ddlpc_serve_slot_busy_fraction" in expo
+    b.close()
+
+
+# ---- obs_tail merge order ---------------------------------------------------
+
+
+def test_obs_tail_merges_streams_by_timestamp(tmp_path, capsys):
+    import obs_tail
+
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    with open(a, "w") as f:
+        for t in (1.0, 3.0, 5.0):
+            f.write(json.dumps({"schema": 1, "time": t, "src": "a"}) + "\n")
+    with open(b, "w") as f:
+        for t in (2.0, 4.0):
+            f.write(json.dumps({"schema": 1, "time": t, "src": "b"}) + "\n")
+    assert obs_tail.main([a, b, "-n", "0"]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    times = [json.loads(l.split("\t", 1)[1])["time"] for l in lines]
+    assert times == [1.0, 2.0, 3.0, 4.0, 5.0]
+
+
+# ---- perf_gate baseline staleness -------------------------------------------
+
+
+def test_perf_gate_baseline_staleness_warnings():
+    import perf_gate
+
+    host = perf_gate.host_fingerprint()
+    now = 1_000_000_000.0
+    fresh = {
+        "generated_at": now - 86400.0,
+        "host": dict(host),
+        "metrics": {},
+        "schema": 1,
+    }
+    assert perf_gate.baseline_warnings(
+        fresh, 30.0, now=now, current_host=host
+    ) == []
+    old = dict(fresh, generated_at=now - 40 * 86400.0)
+    w = perf_gate.baseline_warnings(old, 30.0, now=now, current_host=host)
+    assert any("days old" in x for x in w)
+    foreign = dict(fresh, host=dict(host, hostname="elsewhere"))
+    w = perf_gate.baseline_warnings(foreign, 30.0, now=now, current_host=host)
+    assert any("different host" in x for x in w)
+    unstamped = {"metrics": {}, "schema": 1}
+    w = perf_gate.baseline_warnings(
+        unstamped, 30.0, now=now, current_host=host
+    )
+    assert any("generated_at" in x for x in w)
+    assert any("fingerprint" in x for x in w)

@@ -54,7 +54,15 @@ def test_train_step_runs_and_reduces_loss():
     assert np.isfinite(losses).all()
 
 
-@pytest.mark.parametrize("mode", ["int8", "float16"])
+@pytest.mark.parametrize(
+    "mode",
+    [
+        "int8",
+        # int8 stays the fast arm (the lossier codec); float16 keeps
+        # full coverage in the slow tier (budget maintenance)
+        pytest.param("float16", marks=pytest.mark.slow),
+    ],
+)
 def test_train_step_quantized_runs(mode):
     _, _, _, state, step = _setup(CompressionConfig(mode=mode))
     images, labels = _batch()
