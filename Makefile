@@ -1,18 +1,29 @@
 # Repo-level entry points (docs/ANALYSIS.md).
 #
 #   make check     — the project invariant analyzer (scripts/ddlpc_check.py:
-#                    import tiers, AST rules, lock-order smoke) + the native
-#                    kernel toolchain check (csrc self-test)
+#                    import tiers, AST rules, lock-order smoke) + the fast
+#                    compiled-program contract audit (jaxpr-level,
+#                    scripts/program_audit.py) + the native kernel toolchain
+#                    check (csrc self-test)
+#   make programs  — the FULL compiled-program audit (lowers + compiles
+#                    every registry program, ~2 min; docs/ANALYSIS.md
+#                    "Program-level contracts")
 #   make sanitize  — rebuild + run the csrc self-test & threaded stress
 #                    under ASan/UBSan (TSan where supported)
 #   make test      — the tier-1 suite (what CI runs; see ROADMAP.md)
 
 PYTHON ?= python
 
-check: ddlpc-check csrc-check
+check: ddlpc-check program-check csrc-check
 
 ddlpc-check:
 	$(PYTHON) scripts/ddlpc_check.py
+
+program-check:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/program_audit.py --check --fast
+
+programs:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/program_audit.py --check
 
 csrc-check:
 	$(MAKE) -C csrc check
@@ -23,4 +34,4 @@ sanitize:
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'
 
-.PHONY: check ddlpc-check csrc-check sanitize test
+.PHONY: check ddlpc-check program-check programs csrc-check sanitize test

@@ -74,6 +74,13 @@ MODULE_TIERS: Dict[str, str] = {
     "ddlpc_tpu.analysis.tiers": STDLIB,
     "ddlpc_tpu.analysis.lockcheck": STDLIB,
     "ddlpc_tpu.analysis.lock_fixtures": HOST,  # exercises the serve tier
+    # the HLO/jaxpr walkers are pure text/structure (jaxpr objects come
+    # in as arguments); the program auditor builds and lowers the real
+    # step programs, so it owns the full accelerator stack (its jax
+    # imports stay function-local so the baseline validators import
+    # cheaply from perf_gate --smoke).
+    "ddlpc_tpu.analysis.hlo": STDLIB,
+    "ddlpc_tpu.analysis.program": JAX,
     # serve: the routing/fleet tier is jax-free (numpy allowed — the
     # engine's host-side tiling math); engine compiles lazily.
     "ddlpc_tpu.serve": HOST,

@@ -71,6 +71,7 @@ _PEAK_BY_DEVICE_KIND = (
 
 def _sub_jaxprs(params):
     import jax
+    import jax.extend.core  # noqa: F401  (binds jax.extend — plain `import jax` does not)
 
     for v in params.values():
         if isinstance(v, jax.extend.core.ClosedJaxpr):

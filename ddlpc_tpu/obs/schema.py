@@ -39,6 +39,7 @@ KNOWN_KINDS = frozenset(
         "router",  # fleet router snapshots/events — router.jsonl (serve/router.py)
         "fleet",  # replica supervision events — router.jsonl (serve/fleet.py)
         "analysis",  # static-analysis reports — analysis.jsonl (scripts/ddlpc_check.py)
+        "program",  # compiled-program audits — programs.jsonl (scripts/program_audit.py)
         "slo",  # error-budget ledger — router.jsonl (obs/health.py:SLOTracker)
         "fleet_trace",  # per-request cross-process attribution (obs/merge.py, scripts/fleet_report.py)
     }

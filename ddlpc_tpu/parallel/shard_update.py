@@ -275,19 +275,21 @@ def zero_leaf_spec(
     shape: Tuple[int, ...], n_shards: int, data_axis: str
 ) -> P:
     """GSPMD ZeRO spec for a param-shaped optimizer leaf: partition the
-    largest dimension that divides evenly by the data axis (falling back to
-    the largest dimension ≥ N — GSPMD pads uneven shards); leaves with no
-    dimension ≥ N stay replicated (nothing meaningful to split)."""
+    largest dimension that divides EVENLY by the data axis; leaves with
+    no such dimension stay replicated.  (An uneven pick used to fall
+    back to the largest dimension ≥ N on the theory that GSPMD pads —
+    but an uneven NamedSharding is rejected by ``jit in_shardings`` at
+    the state boundary, so any model with e.g. a 6-class bias on a 4-way
+    mesh crashed at placement.  Surfaced by the compiled-program auditor,
+    docs/ANALYSIS.md "Program-level contracts"; such leaves are a
+    rounding error of the moment bytes, so replicating them costs ~0.)"""
     if not shape:
         return P()
-    dims = sorted(range(len(shape)), key=lambda d: shape[d], reverse=True)
     pick = None
-    for d in dims:
+    for d in sorted(range(len(shape)), key=lambda d: shape[d], reverse=True):
         if shape[d] >= n_shards and shape[d] % n_shards == 0:
             pick = d
             break
-    if pick is None:
-        pick = next((d for d in dims if shape[d] >= n_shards), None)
     if pick is None:
         return P()
     spec = [None] * len(shape)

@@ -556,26 +556,33 @@ def make_train_step_gspmd(
     # and output to have the same size".)
     cache: dict = {}
 
+    def build(state: TrainState):
+        """The inner jit for a state of this tree (avals suffice — the
+        program auditor lowers it on ShapeDtypeStructs without running;
+        ``stepper`` caches it for the real training loop)."""
+        opt_sh = zero.opt_shardings(
+            tx, state.params, "gspmd", mesh, data_axis
+        )
+        state_sh = state.replace(
+            step=repl,
+            params=jax.tree.map(lambda _: repl, state.params),
+            batch_stats=jax.tree.map(lambda _: repl, state.batch_stats),
+            opt_state=opt_sh,
+        )
+        return jax.jit(
+            step_fn,
+            in_shardings=(state_sh, batch_sh, batch_sh),
+            out_shardings=(state_sh, repl),
+            donate_argnums=(0,) if donate_state else (),
+        )
+
     def stepper(state: TrainState, images: jax.Array, labels: jax.Array):
         fn = cache.get("fn")
         if fn is None:
-            opt_sh = zero.opt_shardings(
-                tx, state.params, "gspmd", mesh, data_axis
-            )
-            state_sh = state.replace(
-                step=repl,
-                params=jax.tree.map(lambda _: repl, state.params),
-                batch_stats=jax.tree.map(lambda _: repl, state.batch_stats),
-                opt_state=opt_sh,
-            )
-            fn = cache["fn"] = jax.jit(
-                step_fn,
-                in_shardings=(state_sh, batch_sh, batch_sh),
-                out_shardings=(state_sh, repl),
-                donate_argnums=(0,) if donate_state else (),
-            )
+            fn = cache["fn"] = build(state)
         return fn(state, images, labels)
 
+    stepper.build_for = build
     return stepper
 
 

@@ -12,7 +12,16 @@ outputs alone:
   ``parallel/``;
 - **lock order** — the instrumented-lock smoke (analysis/lockcheck.py)
   runs the threaded hot spots and fails on acquisition-graph cycles or
-  ``# guarded-by:`` violations.
+  ``# guarded-by:`` violations;
+- **compiled programs** (``--programs``) — the program-contract auditor
+  (scripts/program_audit.py, analysis/program.py) in a subprocess: the
+  real step/serve/eval programs lowered on ShapeDtypeStructs and audited
+  for collective census vs obs/comm's closed form, codec dtype flow,
+  fence survival, sharding vs declared specs, and donation aliasing —
+  against the committed docs/analysis/program_baseline.json.  Runs in a
+  fresh process because the audit needs its own XLA_FLAGS (virtual mesh
+  + barrier-expander disable) before backend init; ``--programs-fast``
+  keeps it jaxpr-only (no XLA compile — the tier-1 mode).
 
 Usage:
     python scripts/ddlpc_check.py                       # whole tree
@@ -20,6 +29,7 @@ Usage:
     python scripts/ddlpc_check.py --out runs/analysis.jsonl
     python scripts/ddlpc_check.py --list-rules
     python scripts/ddlpc_check.py --sanitize            # + make -C csrc sanitize
+    python scripts/ddlpc_check.py --programs --programs-fast
 
 Violations print as ``path:line: [rule] message``; suppressed ones are
 counted in the summary.  The ``--out`` stream is flat ``kind="analysis"``
@@ -73,6 +83,59 @@ def _run_lock_fixture(spec: str) -> List[Violation]:
         lockcheck.reset()
 
 
+def _run_program_audit(root: str, fast: bool) -> List[Violation]:
+    """Run scripts/program_audit.py --check in a subprocess and fold its
+    ``VIOLATION <program>: [<contract>] ...`` lines into analyzer
+    violations.  Subprocess, not import: the audit must own XLA_FLAGS
+    (virtual mesh, barrier-expander disable) before jax's backend
+    initializes, and ddlpc_check itself stays jax-free."""
+    cmd = [
+        sys.executable,
+        os.path.join(root, "scripts", "program_audit.py"),
+        "--check",
+    ]
+    if fast:
+        cmd.append("--fast")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, env=env, cwd=root,
+        )
+    except OSError as e:
+        return [
+            Violation("program", "scripts/program_audit.py", 0,
+                      f"program audit could not run: {e}")
+        ]
+    out: List[Violation] = []
+    for line in proc.stdout.splitlines():
+        marker = "VIOLATION "
+        if marker not in line:
+            continue
+        body = line.split(marker, 1)[1]
+        program, _, rest = body.partition(": [")
+        contract, _, message = rest.partition("] ")
+        out.append(
+            Violation(
+                f"program-{contract}" if contract else "program",
+                program or "scripts/program_audit.py", 0,
+                message or body,
+            )
+        )
+    if proc.returncode != 0 and not out:
+        tail = (proc.stderr or proc.stdout).strip().splitlines()[-5:]
+        out.append(
+            Violation(
+                "program", "scripts/program_audit.py", 0,
+                f"program audit exited {proc.returncode} without "
+                f"parseable violations: {' | '.join(tail)}",
+            )
+        )
+    for line in (proc.stderr or "").splitlines():
+        if "WARNING" in line:
+            print(line, file=sys.stderr)
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--root", default=_REPO,
@@ -89,6 +152,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="module:callable to run under lockcheck")
     ap.add_argument("--sanitize", action="store_true",
                     help="also run `make -C csrc sanitize`")
+    ap.add_argument("--programs", action="store_true",
+                    help="also run the compiled-program contract audit "
+                    "(scripts/program_audit.py --check, subprocess)")
+    ap.add_argument("--programs-fast", action="store_true",
+                    help="with --programs: jaxpr-only audit, no XLA "
+                    "compile (tier-1 mode)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -97,6 +166,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         for extra in ("import-tier", "tier-undeclared", "lock-order",
                       "guarded-by", "bad-suppression"):
             print(f"{extra:14s} (see docs/ANALYSIS.md)")
+        print(f"{'program-*':14s} (compiled-program contracts — "
+              f"--programs; docs/ANALYSIS.md)")
         return 0
 
     t0 = time.perf_counter()
@@ -140,6 +211,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 Violation("sanitize", "csrc", 0,
                           "sanitized build failed (make -C csrc sanitize)")
             )
+
+    # --programs-fast implies --programs: the orphan flag silently
+    # skipping the audit would report a clean tree nothing checked.
+    if args.programs or args.programs_fast:
+        violations.extend(
+            _run_program_audit(root, fast=args.programs_fast)
+        )
 
     unsuppressed = [v for v in violations if not v.suppressed]
     suppressed = [v for v in violations if v.suppressed]

@@ -209,7 +209,10 @@ def compare(
     return failures
 
 
-def smoke(baseline_path: str, max_age_days: float = 30.0) -> int:
+def smoke(
+    baseline_path: str, max_age_days: float = 30.0,
+    program_baseline_path: Optional[str] = None,
+) -> int:
     """Validate the committed baseline + self-check the gate logic.
 
     No measurement, no jax import — cheap enough for tier-1.  Fails (1)
@@ -218,6 +221,11 @@ def smoke(baseline_path: str, max_age_days: float = 30.0) -> int:
     Staleness/foreign-host findings print as warnings (the tier-1 run
     must not start failing merely because a month passed — but it must
     SAY so on every run until the baseline is regenerated).
+
+    Also validates the compiled-program contract baseline
+    (``docs/analysis/program_baseline.json``, scripts/program_audit.py)
+    — schema fatal, staleness loud — so the program gate cannot rot
+    unnoticed between full audit runs.
     """
     try:
         with open(baseline_path) as f:
@@ -231,6 +239,28 @@ def smoke(baseline_path: str, max_age_days: float = 30.0) -> int:
             print(f"perf_gate --smoke: {e}")
         return 1
     for w in baseline_warnings(baseline, max_age_days):
+        print(f"perf_gate --smoke: WARNING: {w}", file=sys.stderr)
+
+    from ddlpc_tpu.analysis.program import (  # jax-import-free validators
+        DEFAULT_BASELINE as PROGRAM_BASELINE,
+        baseline_warnings as program_warnings,
+        validate_program_baseline,
+    )
+
+    prog_path = program_baseline_path or PROGRAM_BASELINE
+    try:
+        with open(prog_path) as f:
+            prog_baseline = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"perf_gate --smoke: cannot load program baseline "
+              f"{prog_path}: {e}")
+        return 1
+    prog_errs = validate_program_baseline(prog_baseline)
+    if prog_errs:
+        for e in prog_errs:
+            print(f"perf_gate --smoke: program baseline: {e}")
+        return 1
+    for w in program_warnings(prog_baseline):
         print(f"perf_gate --smoke: WARNING: {w}", file=sys.stderr)
     metrics = baseline["metrics"]
     clean = {n: float(s["value"]) for n, s in metrics.items()}
@@ -254,7 +284,8 @@ def smoke(baseline_path: str, max_age_days: float = 30.0) -> int:
             return 1
     print(
         f"perf_gate --smoke: baseline OK ({len(metrics)} gated metric(s), "
-        f"regression self-check passed)"
+        f"regression self-check passed; program baseline OK, "
+        f"{len(prog_baseline.get('programs', {}))} program(s))"
     )
     return 0
 

@@ -1,0 +1,258 @@
+"""program_audit: the compiled-program contract auditor CLI.
+
+Lowers the repo's REAL programs — both train-step builders, the
+update-only program, eval, the serve engine forwards — on
+ShapeDtypeStructs (nothing materializes, nothing executes) and audits
+the traced jaxpr and the optimized HLO against the declared contracts:
+collective census vs ``obs/comm``'s closed form, codec dtype flow to the
+wire, ``optimization_barrier`` fence survival, per-leaf sharding vs
+declared specs, ``donate_argnums`` input/output aliasing.  See
+``ddlpc_tpu/analysis/program.py`` and docs/ANALYSIS.md "Program-level
+contracts".
+
+Usage:
+    python scripts/program_audit.py --check              # audit vs baseline
+    python scripts/program_audit.py --check --fast       # jaxpr only (tier-1)
+    python scripts/program_audit.py --update-baseline    # rewrite baseline
+    python scripts/program_audit.py --list               # registry
+    python scripts/program_audit.py --inject drop-fence  # must exit 1
+    python scripts/program_audit.py --check --out runs/programs.jsonl
+
+Violations print as ``program_audit: VIOLATION <program>: [<contract>]
+<message>``; ``--out`` emits flat ``kind="program"`` records
+(obs/schema.py contract).  Exit: 0 clean, 1 violations, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# Environment setup MUST precede the first jax/backend use:
+# - the audit mesh is 8 virtual CPU devices (tests/conftest.py topology);
+# - XLA's late barrier-expander pass is disabled so optimization_barrier
+#   fences stay countable in the optimized module (analysis/program.py:
+#   FENCE_XLA_FLAG; the pass only strips fences after they have done
+#   their fusion-blocking job, so the audited program is the real one).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from ddlpc_tpu.analysis.program import FENCE_XLA_FLAG  # noqa: E402
+
+if FENCE_XLA_FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " " + FENCE_XLA_FLAG
+    ).strip()
+
+from ddlpc_tpu.analysis import program as prog  # noqa: E402
+from ddlpc_tpu.obs.schema import check_record, stamp  # noqa: E402
+from ddlpc_tpu.utils.fsio import atomic_write_json, atomic_write_text  # noqa: E402
+
+
+def _force_devices(n: int) -> None:
+    from ddlpc_tpu.utils.compat import force_cpu_devices
+
+    force_cpu_devices(n)
+
+
+def _write_stream(path: str, audits, violations) -> int:
+    lines = []
+    for a in audits:
+        rec = stamp(a.to_record(), kind="program")
+        errs = check_record(rec)
+        if errs:
+            print(f"program_audit: malformed record: {errs}", file=sys.stderr)
+            return 2
+        lines.append(rec)
+    for v in violations:
+        rec = stamp(
+            {
+                "record": "violation",
+                "program": v.program,
+                "contract": v.contract,
+                "message": v.message,
+            },
+            kind="program",
+        )
+        lines.append(rec)
+    summary = stamp(
+        {
+            "record": "summary",
+            "programs": len(audits),
+            "violations": len(violations),
+        },
+        kind="program",
+    )
+    lines.append(summary)
+    atomic_write_text(path, "".join(json.dumps(r) + "\n" for r in lines))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="audit and compare against the committed baseline")
+    ap.add_argument("--fast", action="store_true",
+                    help="jaxpr-level only: no XLA compile (tier-1 mode)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="re-audit (full mode) and rewrite the baseline")
+    ap.add_argument("--baseline", default=prog.DEFAULT_BASELINE)
+    ap.add_argument("--programs", default=None,
+                    help="comma-separated program names (default: all)")
+    ap.add_argument("--list", action="store_true",
+                    help="list the audited program registry")
+    ap.add_argument("--inject", choices=prog.INJECTIONS, default=None,
+                    help="audit a deliberately-violating program "
+                    "(demonstration: must exit 1 naming the contract)")
+    ap.add_argument("--out", default=None,
+                    help="write the kind='program' JSONL stream here")
+    ap.add_argument("--devices", type=int, default=prog.AXIS_SIZE,
+                    help="virtual CPU mesh size (the baseline topology)")
+    ap.add_argument("--max-baseline-age-days", type=float, default=90.0)
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in prog.list_programs():
+            arm, kind = prog.PROGRAMS[name]
+            print(f"{name:32s} arm={arm:16s} kind={kind}")
+        return 0
+
+    _force_devices(args.devices)
+    t0 = time.perf_counter()
+
+    if args.inject is not None:
+        # Injections are self-contained demonstrations: the sharding
+        # class needs the compiled module, the rest fire at jaxpr level.
+        fast = args.inject != "replicated-leaf"
+        bundle = prog.build_injection(args.inject)
+        audit = prog.audit_program(bundle.name, fast=fast, bundle=bundle)
+        violations = list(audit.violations)
+        if args.inject == "extra-collective":
+            # The census drift is also visible against the committed
+            # baseline of the program the injection wraps.
+            baseline = _load_baseline(args.baseline)
+            if baseline is not None:
+                entry = baseline.get("programs", {}).get(
+                    "int8_simulate/update_step"
+                )
+                violations.extend(
+                    prog.compare_to_baseline(audit, entry, fast=True)
+                )
+        for v in violations:
+            print(f"program_audit: {v.format()}")
+        dt = time.perf_counter() - t0
+        print(
+            f"program_audit: --inject {args.inject}: "
+            f"{len(violations)} violation(s), {dt:.1f}s",
+            file=sys.stderr,
+        )
+        if not violations:
+            print(
+                f"program_audit: INJECTION NOT CAUGHT: {args.inject} "
+                f"produced no violation — the auditor is blind to this "
+                f"contract class",
+                file=sys.stderr,
+            )
+            return 2
+        return 1
+
+    if not (args.check or args.update_baseline):
+        ap.error("pick one of --check / --update-baseline / --list / --inject")
+
+    fast = bool(args.fast) and not args.update_baseline
+    names = (
+        [n.strip() for n in args.programs.split(",") if n.strip()]
+        if args.programs
+        else prog.list_programs()
+    )
+    unknown = [n for n in names if n not in prog.PROGRAMS]
+    if unknown:
+        print(
+            f"program_audit: unknown program(s): {', '.join(unknown)} "
+            f"(see --list)",
+            file=sys.stderr,
+        )
+        return 2
+
+    audits = []
+    violations = []
+    for name in names:
+        audit = prog.audit_program(name, fast=fast)
+        audits.append(audit)
+        violations.extend(audit.violations)
+
+    if args.update_baseline:
+        if args.programs:
+            print(
+                "program_audit: --update-baseline regenerates the FULL "
+                "registry; --programs is ignored for the write",
+                file=sys.stderr,
+            )
+            audits = [
+                prog.audit_program(n, fast=False)
+                for n in prog.list_programs()
+                if n not in names
+            ] + audits
+            violations = [v for a in audits for v in a.violations]
+        baseline = prog.build_baseline(audits)
+        os.makedirs(os.path.dirname(args.baseline) or ".", exist_ok=True)
+        atomic_write_json(args.baseline, baseline)
+        for v in violations:
+            print(f"program_audit: {v.format()}")
+        print(f"program_audit: baseline written to {args.baseline} "
+              f"({len(audits)} programs)")
+        # Absolute-contract violations still fail: a baseline must not
+        # be regenerated over a tree that breaks its own declarations.
+        return 1 if violations else 0
+
+    baseline = _load_baseline(args.baseline)
+    if baseline is None:
+        print(f"program_audit: cannot load baseline {args.baseline} — "
+              f"run --update-baseline first", file=sys.stderr)
+        return 2
+    errs = prog.validate_program_baseline(baseline)
+    if errs:
+        for e in errs:
+            print(f"program_audit: {e}", file=sys.stderr)
+        return 2
+    for w in prog.baseline_warnings(baseline, args.max_baseline_age_days):
+        print(f"program_audit: WARNING: {w}", file=sys.stderr)
+    table = baseline.get("programs", {})
+    for audit in audits:
+        violations.extend(
+            prog.compare_to_baseline(audit, table.get(audit.name), fast)
+        )
+
+    rc = 0
+    for v in violations:
+        print(f"program_audit: {v.format()}")
+        rc = 1
+    if args.out:
+        out_rc = _write_stream(args.out, audits, violations)
+        if out_rc:
+            return out_rc
+    dt = time.perf_counter() - t0
+    print(
+        f"program_audit: {len(audits)} program(s) "
+        f"({'jaxpr' if fast else 'jaxpr+hlo'}), "
+        f"{len(violations)} violation(s), {dt:.1f}s",
+        file=sys.stderr,
+    )
+    return rc
+
+
+def _load_baseline(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+if __name__ == "__main__":
+    sys.exit(main())
