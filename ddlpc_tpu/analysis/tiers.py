@@ -98,6 +98,11 @@ MODULE_TIERS: Dict[str, str] = {
     "ddlpc_tpu.serve.server": HOST,
     "ddlpc_tpu.serve.router": HOST,
     "ddlpc_tpu.serve.fleet": HOST,
+    # elastic-fleet control plane (ISSUE 16): both are stdlib-only code,
+    # HOST for the same parent-package reason as batching — proving the
+    # autoscaler/cache never pay a jax import is the point of the tier.
+    "ddlpc_tpu.serve.autoscale": HOST,
+    "ddlpc_tpu.serve.cache": HOST,
     # utils: wire/fsio are stdlib; native needs numpy; compat IS the jax
     # shim layer.
     "ddlpc_tpu.utils": STDLIB,

@@ -576,6 +576,28 @@ class FleetConfig:
     slo_fast_burn: float = 14.0  # burn-rate threshold (critical)
     slo_slow_window_s: float = 3600.0
     slo_slow_burn: float = 2.0  # burn-rate threshold (warn)
+    # Elastic fleet (serve/autoscale.py; docs/SERVING.md "Elastic fleet"):
+    # a policy loop scales the replica count between the min/max bounds on
+    # SLO burn rate, interactive queue depth, and slot-busy fraction, with
+    # a cooldown between actions so it never flaps.  Scale-up triggers
+    # when ANY high-water mark is crossed; scale-down requires EVERY
+    # signal under its low-water mark (and burn rate < 1.0).
+    autoscale_enabled: bool = False
+    autoscale_min_replicas: int = 1
+    autoscale_max_replicas: int = 8
+    autoscale_interval_s: float = 2.0  # policy evaluation cadence
+    autoscale_cooldown_s: float = 30.0  # min seconds between actions
+    autoscale_burn_threshold: float = 2.0  # interactive fast-window burn
+    autoscale_queue_depth_high: float = 8.0  # mean interactive queue/replica
+    autoscale_queue_depth_low: float = 1.0
+    autoscale_slot_busy_high: float = 0.85  # max replica slot-busy fraction
+    autoscale_slot_busy_low: float = 0.30
+    # Content-addressed response cache (serve/cache.py): the router
+    # answers repeated tiles from memory, keyed by sha256(input bytes +
+    # serving step + quant mode), LRU-bounded by payload bytes and
+    # invalidated fleet-wide whenever the serving step changes.  0 = off;
+    # ?cache=bypass skips it per request.
+    cache_max_bytes: int = 0
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)

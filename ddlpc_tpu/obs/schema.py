@@ -42,6 +42,8 @@ KNOWN_KINDS = frozenset(
         "program",  # compiled-program audits — programs.jsonl (scripts/program_audit.py)
         "slo",  # error-budget ledger — router.jsonl (obs/health.py:SLOTracker)
         "fleet_trace",  # per-request cross-process attribution (obs/merge.py, scripts/fleet_report.py)
+        "autoscale",  # elastic-fleet policy decisions — router.jsonl (serve/autoscale.py)
+        "cache",  # response-cache stats snapshots — router.jsonl (serve/cache.py)
     }
 )
 

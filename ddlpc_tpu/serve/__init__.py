@@ -32,6 +32,14 @@ socket cluster; this package is the inference counterpart of that ambition
 - :mod:`fleet`    — replica supervision (launch/classify/backoff/relaunch
                     via resilience/supervisor.py machinery) and the
                     zero-downtime rolling hot-reload protocol.
+- :mod:`autoscale` — SLO-driven elastic fleet: a policy loop scaling the
+                    replica count on burn rate / queue depth / slot-busy
+                    signals through the supervisor's runtime
+                    scale_up/scale_down (jax-free).
+- :mod:`cache`    — content-addressed response cache in the router:
+                    sha256(input bytes + serving step + quant mode) →
+                    logits, LRU-bounded by bytes, flushed fleet-wide when
+                    the serving step changes (jax-free).
 """
 
 from ddlpc_tpu.serve.batching import (  # noqa: F401
@@ -40,6 +48,8 @@ from ddlpc_tpu.serve.batching import (  # noqa: F401
     MicroBatcher,
     Overloaded,
 )
+from ddlpc_tpu.serve.autoscale import Autoscaler  # noqa: F401
+from ddlpc_tpu.serve.cache import ResponseCache, response_key  # noqa: F401
 from ddlpc_tpu.serve.cbatch import ContinuousBatcher  # noqa: F401
 from ddlpc_tpu.serve.engine import (  # noqa: F401
     InferenceEngine,

@@ -80,6 +80,10 @@ class _ManagedReplica:
         self.launches = 0
         self.became_ready = False  # since the most recent launch
         self.gave_up = False
+        # Scale-down marker: a retired replica's supervision loop exits
+        # instead of relaunching when its process dies — the orderly
+        # counterpart of gave_up (serve/autoscale.py drives it).
+        self.retired = False
         self.ready_evt = threading.Event()
 
 
@@ -127,10 +131,30 @@ class ReplicaSupervisor:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._reload_lock = threading.Lock()
+        # replicas/_threads grow at runtime (scale_up) — every iteration
+        # works on a snapshot taken under this lock.
+        self._replicas_lock = threading.Lock()
         self.replicas: List[_ManagedReplica] = []
         for i in range(cfg.replicas):
             home = os.path.join(self.fleet_dir, f"r{i}")
             self.replicas.append(_ManagedReplica(i, home, cfg))
+        self._next_idx = cfg.replicas  # next scale-up replica index
+
+    def _snapshot(self) -> List[_ManagedReplica]:
+        with self._replicas_lock:
+            return list(self.replicas)
+
+    def _spawn_supervision(self, rp: _ManagedReplica) -> None:
+        """Config on disk + one supervision thread — shared by boot-time
+        start() and runtime scale_up()."""
+        self._write_serve_config(rp)
+        t = threading.Thread(
+            target=self._run_replica, args=(rp,),
+            name=f"fleet-{rp.name}", daemon=True,
+        )
+        with self._replicas_lock:
+            self._threads.append(t)
+        t.start()
 
     # -- plumbing -----------------------------------------------------------
 
@@ -226,7 +250,7 @@ class ReplicaSupervisor:
     # -- the per-replica supervision loop ------------------------------------
 
     def _run_replica(self, rp: _ManagedReplica) -> None:
-        while not self._stop.is_set():
+        while not self._stop.is_set() and not rp.retired:
             self._launch(rp)
             if self._wait_ready(rp) and not self._stop.is_set():
                 rp.became_ready = True
@@ -265,6 +289,12 @@ class ReplicaSupervisor:
             )
             if self._stop.is_set():
                 return
+            if rp.retired:
+                # Scale-down completing: the exit was ordered, not a
+                # failure — no restart accounting, no relaunch.
+                self._say(f"{rp.name}: retired (scale-down)")
+                self._log("replica_retired", replica=rp.name)
+                return
             self._restarts.inc(replica=rp.name, cause=cause)
             decision = rp.policy.record_exit(progressed=rp.became_ready)
             if decision != "restart":
@@ -291,38 +321,84 @@ class ReplicaSupervisor:
         With ``wait_ready`` blocks until each is ready or its warmup
         window expired; returns how many are ready."""
         os.makedirs(self.fleet_dir, exist_ok=True)
-        for rp in self.replicas:
-            self._write_serve_config(rp)
-            t = threading.Thread(
-                target=self._run_replica, args=(rp,),
-                name=f"fleet-{rp.name}", daemon=True,
-            )
-            self._threads.append(t)
-            t.start()
+        initial = self._snapshot()
+        for rp in initial:
+            self._spawn_supervision(rp)
         self.router.start()
         if not wait_ready:
             return 0
         n = 0
-        for rp in self.replicas:
+        for rp in initial:
             if rp.ready_evt.wait(self.cfg.warmup_timeout_s):
                 n += 1
         return n
 
     def ready_count(self) -> int:
-        return sum(1 for rp in self.replicas if rp.ready_evt.is_set())
+        return sum(1 for rp in self._snapshot() if rp.ready_evt.is_set())
+
+    # -- runtime elasticity (serve/autoscale.py drives these) ----------------
+
+    def replica_count(self) -> int:
+        """Replicas the fleet is steering toward: live or relaunching,
+        excluding retired and given-up ones."""
+        return sum(
+            1 for rp in self._snapshot() if not rp.retired and not rp.gave_up
+        )
+
+    def scale_up(self) -> str:
+        """Add one replica at runtime; returns its name immediately.
+        Scale-up races warmup by design: the new replica enters dispatch
+        through the SAME port-file + /healthz readiness gate as a boot
+        launch, so first traffic never pays its compile."""
+        with self._replicas_lock:
+            idx = self._next_idx
+            self._next_idx += 1
+            home = os.path.join(self.fleet_dir, f"r{idx}")
+            rp = _ManagedReplica(idx, home, self.cfg)
+            self.replicas.append(rp)
+        os.makedirs(self.fleet_dir, exist_ok=True)
+        self._spawn_supervision(rp)
+        self._log("scale_up_launch", replica=rp.name)
+        return rp.name
+
+    def scale_down(self, name: str) -> bool:
+        """Retire one replica at runtime: drain its in-flight work, then
+        SIGTERM (the replica's own drain path finishes the rest).  The
+        supervision loop sees ``retired`` and exits instead of
+        relaunching.  Returns False if ``name`` isn't a live candidate."""
+        with self._replicas_lock:
+            rp = next(
+                (
+                    r for r in self.replicas
+                    if r.name == name and not r.retired and not r.gave_up
+                ),
+                None,
+            )
+        if rp is None:
+            return False
+        rp.retired = True
+        self.router.drain(name, self.cfg.drain_timeout_s)
+        if rp.proc is not None and rp.proc.poll() is None:
+            try:
+                rp.proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        self._log("scale_down_retire", replica=name)
+        return True
 
     def stop(self, grace_s: float = 30.0) -> None:
         """Graceful fleet shutdown: SIGTERM every replica (each drains —
         finish in-flight, flush metrics, exit 0), SIGKILL stragglers."""
         self._stop.set()
-        for rp in self.replicas:
+        live = self._snapshot()
+        for rp in live:
             if rp.proc is not None and rp.proc.poll() is None:
                 try:
                     rp.proc.send_signal(signal.SIGTERM)
                 except OSError:
                     pass
         deadline = time.monotonic() + grace_s
-        for rp in self.replicas:
+        for rp in live:
             if rp.proc is None:
                 continue
             remaining = max(deadline - time.monotonic(), 0.1)
@@ -335,7 +411,9 @@ class ReplicaSupervisor:
                     rp.proc.wait(timeout=10)
                 except (OSError, subprocess.TimeoutExpired):
                     pass
-        for t in self._threads:
+        with self._replicas_lock:
+            threads = list(self._threads)
+        for t in threads:
             t.join(timeout=10)
         self.router.close()
 
@@ -387,8 +465,9 @@ class ReplicaSupervisor:
     def _rolling_reload_locked(self, step, workdir) -> dict:
         live = [
             rp
-            for rp in self.replicas
+            for rp in self._snapshot()
             if rp.ready_evt.is_set() and rp.client is not None
+            and not rp.retired
         ]
         if not live:
             return {"ok": False, "error": "no ready replicas"}
@@ -447,6 +526,10 @@ class ReplicaSupervisor:
                 self.router.readmit(rp.name)
                 for u in updated:
                     rollback_ok.append(self._reload_to(u, old_step))
+                # Replicas that already served the new step are back on
+                # the old one: anything cached against EITHER step is
+                # suspect until the fleet re-converges — flush.
+                self.router.invalidate_cache("reload_rollback")
                 self.router.metrics.record_reload(ok=False)
                 self._log(
                     "rolling_reload_aborted", replica=rp.name, reason=reason,
@@ -478,6 +561,12 @@ class ReplicaSupervisor:
                 time.sleep(0.1)
             self.router.readmit(rp.name)
             updated.append(rp)
+        # The serving step moved: every cached response answered for the
+        # old weights.  (The router's consensus watcher would also catch
+        # this on the next scrape; the explicit call makes the flush
+        # synchronous with the reload result.)
+        if new_step != old_step:
+            self.router.invalidate_cache("rolling_reload")
         self.router.metrics.record_reload(ok=True)
         self._log(
             "rolling_reload_done", step=new_step, old_step=old_step,
@@ -500,8 +589,9 @@ class ReplicaSupervisor:
                     "ready": rp.ready_evt.is_set(),
                     "launches": rp.launches,
                     "gave_up": rp.gave_up,
+                    "retired": rp.retired,
                 }
-                for rp in self.replicas
+                for rp in self._snapshot()
             ],
         }
 
@@ -685,6 +775,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         cfg, router=router, logger=logger, aggregator=aggregator
     )
     n = sup.start(wait_ready=True)
+    autoscaler = None
+    if cfg.autoscale_enabled:
+        from ddlpc_tpu.serve.autoscale import Autoscaler
+
+        autoscaler = Autoscaler(
+            cfg, router, sup, logger=logger, registry=registry
+        )
+        autoscaler.start()
     server = make_fleet_server(
         router, sup, cfg.host, cfg.port, aggregator=aggregator
     )
@@ -703,6 +801,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         server.serve_forever()
     finally:
         server.server_close()
+        if autoscaler is not None:
+            autoscaler.close()
         sup.stop()
         if aggregator is not None:
             aggregator.close()

@@ -59,6 +59,7 @@ from ddlpc_tpu.obs.tracing import (
     new_span_hex,
     new_trace_id,
 )
+from ddlpc_tpu.serve.cache import ResponseCache, response_key
 
 Response = Tuple[int, str, bytes]  # (status, content-type, body)
 
@@ -80,6 +81,30 @@ def _priority_of(query: str) -> str:
         return "interactive"
     p = parse_qs(query).get("priority", ["interactive"])[0]
     return p if p == "batch" else "interactive"
+
+
+def _cache_bypass(query: str) -> bool:
+    """Per-request cache opt-out: ``?cache=bypass`` skips both lookup and
+    fill (the request is routed and measured exactly as with the cache
+    off — what the perf arm compares against)."""
+    if not query:
+        return False
+    return parse_qs(query).get("cache", [""])[0] == "bypass"
+
+
+def _is_conn_refused(e: BaseException) -> bool:
+    """Walk the exception chain for a ConnectionRefusedError.  Clients
+    wrap transport errors (``ReplicaError ... from e``), so the refused
+    signal — "nothing is listening on that port yet" — arrives as a
+    ``__cause__``/``__context__`` link, not the top-level type."""
+    seen = set()
+    cur: Optional[BaseException] = e
+    while cur is not None and id(cur) not in seen:
+        if isinstance(cur, ConnectionRefusedError):
+            return True
+        seen.add(id(cur))
+        cur = cur.__cause__ or cur.__context__
+    return False
 
 
 def _percentile(sorted_vals: Sequence[float], q: float) -> Optional[float]:
@@ -441,7 +466,37 @@ class RouterMetrics:
                     "ddlpc_router_replicas_ready",
                     "Replicas currently eligible for dispatch.",
                 ),
+                "cache_hits": registry.counter(
+                    "ddlpc_cache_hits_total",
+                    "Predict requests answered from the response cache.",
+                ),
+                "cache_misses": registry.counter(
+                    "ddlpc_cache_misses_total",
+                    "Cacheable predict requests that missed the cache.",
+                ),
+                "cache_evictions": registry.counter(
+                    "ddlpc_cache_evictions_total",
+                    "Cache entries evicted by the LRU byte bound.",
+                ),
+                "cache_invalidations": registry.counter(
+                    "ddlpc_cache_invalidations_total",
+                    "Fleet-wide cache flushes (serving step changed).",
+                ),
+                "cache_bytes": registry.gauge(
+                    "ddlpc_cache_bytes",
+                    "Payload bytes currently held by the response cache.",
+                ),
+                "cache_entries": registry.gauge(
+                    "ddlpc_cache_entries",
+                    "Entries currently held by the response cache.",
+                ),
             }
+        # Last cache totals pushed to the registry, so sync_cache can inc
+        # the monotonic counters by delta (the cache keeps the totals).
+        self._cache_seen = {
+            "cache_hits": 0, "cache_misses": 0,
+            "cache_evictions": 0, "cache_invalidations": 0,
+        }
 
     def record_request(self, latency_s: float, ok: bool) -> None:
         with self._lock:
@@ -517,6 +572,20 @@ class RouterMetrics:
         if self._reg is not None:
             self._reg["ready"].set(n)
 
+    def sync_cache(self, stats: Dict[str, float]) -> None:
+        """Push a ResponseCache.stats() snapshot to the registry: gauges
+        are set absolutely, counters advance by delta since last sync."""
+        if self._reg is None:
+            return
+        self._reg["cache_bytes"].set(float(stats["cache_bytes"]))
+        self._reg["cache_entries"].set(float(stats["cache_entries"]))
+        for key in self._cache_seen:
+            total = int(stats[key])
+            delta = total - self._cache_seen[key]
+            if delta > 0:
+                self._reg[key].inc(delta)
+            self._cache_seen[key] = total
+
     def snapshot(self, advance: bool = True) -> Dict[str, object]:
         with self._lock:
             now = time.monotonic()
@@ -582,7 +651,14 @@ class _Replica:
         self.occupancy: Optional[float] = None  # scraped
         self.checkpoint_step: Optional[int] = None  # scraped
         self.version: Optional[int] = None  # scraped
+        self.slot_busy: Optional[float] = None  # scraped (autoscaler signal)
         self.scrape_fail_streak = 0
+        # True once this replica has EVER answered anything (a successful
+        # scrape or any HTTP response to an attempt).  Until then a
+        # connection-refused is "still warming", not "failing": the
+        # replica is scored ineligible without feeding its breaker, so a
+        # scale-up can never open a breaker on a replica mid-launch.
+        self.ever_ok = False
 
     def status(self) -> Dict[str, object]:
         return {
@@ -599,6 +675,7 @@ class _Replica:
             "occupancy": self.occupancy,
             "checkpoint_step": self.checkpoint_step,
             "version": self.version,
+            "slot_busy": self.slot_busy,
         }
 
 
@@ -656,7 +733,12 @@ class FleetRouter:
         self.slo = SLOTracker.from_fleet_config(
             self.cfg, registry=self.registry, monitor=self.health
         )
+        # Content-addressed response cache (serve/cache.py): repeated
+        # tiles answer from memory when the fleet serves one consistent
+        # (step, quant) identity.  max_bytes=0 keeps every call a no-op.
+        self.cache = ResponseCache(self.cfg.cache_max_bytes)
         self._lock = lockcheck.lock("FleetRouter._lock")
+        self._cache_step: Optional[int] = None  # guarded-by: _lock
         self._replicas: dict = {}  # guarded-by: _lock
         self._rr = 0  # guarded-by: _lock (round-robin tiebreaker)
         self._drain_cond = lockcheck.condition(lock=self._lock)
@@ -738,10 +820,20 @@ class FleetRouter:
         for r in targets:
             try:
                 h = r.client.healthz(self.cfg.scrape_timeout_s)
-            except Exception:
+            except Exception as e:
                 with self._lock:
                     r.scrape_fail_streak += 1
-                    if r.scrape_fail_streak >= self.cfg.unhealthy_after:
+                    if _is_conn_refused(e) and not r.ever_ok:
+                        # Mid-launch: the port isn't listening yet.  Take
+                        # the replica out of rotation NOW (don't wait for
+                        # unhealthy_after) but stay off its breaker — a
+                        # warming replica has done nothing wrong.
+                        if r.healthy:
+                            self._log_event(
+                                "replica_warming", replica=r.name,
+                            )
+                        r.healthy = False
+                    elif r.scrape_fail_streak >= self.cfg.unhealthy_after:
                         if r.healthy:
                             self._log_event(
                                 "replica_unhealthy", replica=r.name,
@@ -754,6 +846,7 @@ class FleetRouter:
                     self._log_event("replica_recovered", replica=r.name)
                 r.scrape_fail_streak = 0
                 r.healthy = True
+                r.ever_ok = True
                 r.queue_depth = int(h.get("queue_depth") or 0)
                 r.queue_depth_interactive = int(
                     h.get("queue_depth_interactive", h.get("queue_depth"))
@@ -765,6 +858,8 @@ class FleetRouter:
                 r.occupancy = float(occ) if occ is not None else None
                 r.checkpoint_step = h.get("checkpoint_step")
                 r.version = h.get("version")
+                sb = h.get("slot_busy_fraction")
+                r.slot_busy = float(sb) if sb is not None else None
                 if h.get("status") == "draining":
                     # The replica is shutting down on its own (SIGTERM):
                     # treat like a router-side drain — no new dispatch.
@@ -814,6 +909,16 @@ class FleetRouter:
                 self.logger.log(self.slo.status(), echo=False)
             except Exception:
                 pass  # accounting must never break dispatch
+        if self.cache.enabled:
+            stats = self.cache.stats()
+            self.metrics.sync_cache(stats)
+            if self.logger is not None:
+                try:
+                    self.logger.log(
+                        {"kind": "cache", **stats}, echo=False
+                    )
+                except Exception:
+                    pass
         return snap
 
     def _log_event(self, event: str, **fields) -> None:
@@ -998,9 +1103,23 @@ class FleetRouter:
                 resp = call()
                 a.outcome = ("response", resp)
                 ok = resp[0] < 500
+                with self._lock:
+                    r.ever_ok = True  # answered: warming grace is over
             except Exception as e:
                 a.outcome = ("fail", e)
                 ok = False
+                if _is_conn_refused(e) and not r.ever_ok:
+                    # Still warming (supervisor raced readiness, or a fake
+                    # marked it ready early): neutral for the breaker —
+                    # release the permit without recording an outcome —
+                    # and out of rotation until a scrape succeeds.
+                    ok = None
+                    with self._lock:
+                        if r.healthy:
+                            self._log_event(
+                                "replica_warming", replica=r.name,
+                            )
+                        r.healthy = False
             if ok is False and a.cancel.is_set():
                 ok = None  # cancelled loser: neutral for the breaker
             self._finish_attempt(a, ok)
@@ -1072,6 +1191,20 @@ class FleetRouter:
                 "retry with backoff"
             )
         t0 = time.monotonic()
+        cache_key = None
+        if self.cache.enabled and not _cache_bypass(query):
+            ident = self._cache_identity()
+            if ident is not None:
+                cache_key = response_key(body, ident[0], ident[1])
+                cached = self.cache.get(cache_key)
+                if cached is not None:
+                    # A hit is a real answered request: it feeds the same
+                    # ledgers (latency ring, SLO) as a routed one — the
+                    # p99 win must be visible, not hidden from the stats.
+                    latency_s = time.monotonic() - t0
+                    self.metrics.record_request(latency_s, True)
+                    self.slo.observe(priority, latency_s, True)
+                    return cached
         tr = self.tracer
         if tr is not None and tr.enabled:
             trace_id, parent_hex = (
@@ -1093,7 +1226,57 @@ class FleetRouter:
         latency_s = time.monotonic() - t0
         self.metrics.record_request(latency_s, ok)
         self.slo.observe(priority, latency_s, ok)
+        if cache_key is not None and ok:
+            self.cache.put(cache_key, (status, ctype, payload))
         return status, ctype, payload
+
+    # -- response cache -----------------------------------------------------
+
+    def _cache_identity(self) -> Optional[Tuple[int, str]]:
+        """The fleet's consensus serving identity (step, quant mode), or
+        None when there isn't one — no scraped step yet, or mixed steps /
+        quant modes mid-rolling-reload (caching simply pauses; the step
+        is also in the key, so this is belt on top of braces).  A
+        consensus step DIFFERENT from the last one flushes the cache:
+        that is the fleet-wide invalidation on any reload — forward or
+        rollback — that changes the serving step."""
+        flush = False
+        with self._lock:
+            live = [
+                r for r in self._replicas.values()
+                if r.ready and r.healthy and not r.draining
+                and r.checkpoint_step is not None
+            ]
+            steps = {int(r.checkpoint_step) for r in live}
+            quants = {r.quant_mode or "none" for r in live}
+            if len(steps) != 1 or len(quants) != 1:
+                return None
+            step, quant = steps.pop(), quants.pop()
+            if self._cache_step is not None and self._cache_step != step:
+                flush = True
+            self._cache_step = step
+        if flush:
+            # Outside _lock: the router lock must never wait on the cache
+            # lock while a put is evicting.
+            dropped = self.cache.invalidate("step_change")
+            self._log_event(
+                "cache_invalidate", reason="step_change", dropped=dropped,
+                step=step,
+            )
+        return step, quant
+
+    def invalidate_cache(self, reason: str) -> int:
+        """Fleet-wide cache flush, called by the supervisor around any
+        reload outcome that moves the serving step (including the
+        rollback after an aborted one).  Always logged when the cache is
+        on — the soak audits for this record on the rollback path."""
+        if not self.cache.enabled:
+            return 0
+        dropped = self.cache.invalidate(reason)
+        with self._lock:
+            self._cache_step = None  # re-learn consensus from scrapes
+        self._log_event("cache_invalidate", reason=reason, dropped=dropped)
+        return dropped
 
     def _error(self, status: int, msg: str) -> Response:
         return status, "application/json", json.dumps({"error": msg}).encode()
@@ -1219,6 +1402,8 @@ class FleetRouter:
             ),
             "replica_status": statuses,
         }
+        if self.cache.enabled:
+            out["cache"] = self.cache.stats()
         if self.slo.enabled:
             # Error budgets + burn rates on the fleet's ONE health
             # endpoint (ISSUE 14 tentpole: the SLO layer is scrapeable

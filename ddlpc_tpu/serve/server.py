@@ -365,6 +365,7 @@ class ServingFrontend:
         # endpoint instead of parsing the full /metrics exposition; the
         # per-priority depths and quant mode keep that one-scrape contract
         # sufficient for priority-aware dispatch and quantized rollouts.
+        slot_busy = self.metrics.slot_busy  # replaced atomically on emit
         depths_fn = getattr(self.batcher, "queue_depths", None)
         depths = (
             depths_fn()
@@ -386,6 +387,16 @@ class ServingFrontend:
             "queue_limit": self.cfg.queue_limit,
             "quant_mode": getattr(self.engine, "quantize_mode", "off"),
             "batch_occupancy": self.metrics.occupancy(),
+            # Mean of the LAST PUBLISHED per-slot busy fractions (emit
+            # cadence) — reading the batcher here would consume its
+            # readout window out from under the metrics emitter.  None
+            # until the first emit, or without a continuous batcher; the
+            # autoscaler treats None as "no signal".
+            "slot_busy_fraction": (
+                sum(slot_busy.values()) / len(slot_busy)
+                if slot_busy
+                else None
+            ),
             "compiled_shapes": self.engine.compiled_shapes,
             "last_reload_error": self.last_reload_error,
             "alerts": list(self.health.alerts),

@@ -1,0 +1,444 @@
+"""Elastic-fleet soak: the autoscaler + response cache under a traffic
+step, a cache A/B, and a corrupt rolling reload (ISSUE 16 acceptance
+evidence — the elastic companion to scripts/fleet_soak.py).
+
+What it proves, end to end, on CPU:
+
+- a **4× traffic step** (2 → 8 closed-loop clients) is absorbed with the
+  error budget intact: the autoscaler sees the queue/slot-busy pressure
+  and grows the fleet, and the ``kind="autoscale"`` JSONL records show
+  replica count following load (scale-ups carrying their triggering
+  signal values, then scale-downs after the step ends);
+- the **repeated-scene cache arm**: hot-set traffic served from the
+  content-addressed response cache has hit-rate > 0 and a measured p99
+  strictly below the same traffic forced through ``?cache=bypass``;
+- a **rolling reload with an active autoscaler** still aborts
+  fleet-wide when a replica corrupts its blob (``reload_corrupt``
+  chaos): the blob is quarantined, every updated replica is rolled back
+  to the old step, and the rollback path emits the
+  ``cache_invalidate reason=reload_rollback`` record — the cache can
+  never outlive the weights that produced its entries;
+- every JSONL stream (router + autoscale + cache records included)
+  lints clean against the flat-record schema.
+
+Usage:
+    python scripts/elastic_soak.py --out docs/resilience/elastic_soak.json
+    python scripts/elastic_soak.py --quick     # shorter phases
+
+The committed evidence lives at docs/resilience/elastic_soak.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import shutil
+import struct
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_stream(path: str) -> int:
+    """Schema-lint one JSONL stream; returns violation count."""
+    from check_metrics_schema import lint_file
+
+    if not os.path.exists(path):
+        return 0
+    return len(lint_file(path))
+
+
+def _p99(samples_ms) -> float:
+    if not samples_ms:
+        return 0.0
+    s = sorted(samples_ms)
+    return round(s[min(int(0.99 * (len(s) - 1)), len(s) - 1)], 3)
+
+
+def run_soak(args) -> dict:
+    import numpy as np
+
+    from serve_bench import make_tiny_run
+    from ddlpc_tpu.config import FleetConfig
+    from ddlpc_tpu.serve.autoscale import Autoscaler
+    from ddlpc_tpu.serve.fleet import ReplicaSupervisor
+    from ddlpc_tpu.serve.router import FleetRouter
+    from ddlpc_tpu.train.observability import MetricsLogger
+
+    t_start = time.time()
+    base = args.workdir
+    shutil.rmtree(base, ignore_errors=True)
+    workdir = os.path.join(base, "run")
+    make_tiny_run(workdir, seed=0, step=1)
+
+    base_clients = 2
+    stepped_clients = 8  # a 4x step — inside the >=2x..8x acceptance band
+    phase_s = {
+        "baseline": 6.0 if args.quick else 10.0,
+        "stepped": 25.0 if args.quick else 35.0,
+        "downscale": 35.0 if args.quick else 45.0,
+    }
+    cache_requests = 150 if args.quick else 300
+
+    cfg = FleetConfig(
+        workdir=workdir,
+        replicas=2,
+        max_batch=4,
+        max_wait_ms=2.0,
+        queue_limit=256,
+        deadline_ms=0.0,
+        request_timeout_ms=2000.0,
+        retries=3,
+        retry_backoff_ms=10.0,
+        hedge_ms=0.0,  # a saturating step measures capacity, not tail
+        scrape_every_s=1.0,
+        warmup_timeout_s=args.warmup_timeout_s,
+        crash_loop_limit=3,
+        backoff_base_s=0.2,
+        backoff_cap_s=2.0,
+        metrics_every_s=2.0,
+        # SLO objective the "error budget intact" claim is audited
+        # against: 98% good requests on a 60 s fast window — a CPU-host
+        # soak objective, not the production default.
+        slo_availability=0.98,
+        slo_fast_window_s=60.0,
+        # the elastic subsystem under test:
+        autoscale_enabled=True,
+        autoscale_min_replicas=2,
+        autoscale_max_replicas=4,
+        autoscale_interval_s=1.0,
+        autoscale_cooldown_s=6.0,
+        # Host-shaped thresholds: saturated CPU replicas here show a
+        # sustained slot-busy fraction ~0.83 (window-averaged, stable)
+        # while the batcher's admission queue stays shallow (mean 0-1.5
+        # — max_batch drains it between scrapes), so slot busy is the
+        # primary trigger and queue depth the secondary.
+        autoscale_queue_depth_high=1.5,
+        autoscale_queue_depth_low=0.5,
+        autoscale_slot_busy_high=0.70,
+        autoscale_slot_busy_low=0.30,
+        cache_max_bytes=64 << 20,
+    )
+
+    # Replica 1 corrupts its blob on its first /reload → quarantine →
+    # fleet-wide abort; replica 0 (already updated by then) rolls back.
+    schedule = {(1, 1): "reload_corrupt@1"}
+
+    def env_fn(idx: int, launch: int):
+        env = dict(os.environ)
+        env.pop("DDLPC_CHAOS", None)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        spec = schedule.get((idx, launch))
+        if spec:
+            env["DDLPC_CHAOS"] = spec
+        return env
+
+    fleet_dir = cfg.resolved_fleet_dir()
+    os.makedirs(fleet_dir, exist_ok=True)
+    logger = MetricsLogger(fleet_dir, basename="router")
+    router = FleetRouter(cfg, logger=logger)
+    sup = ReplicaSupervisor(
+        cfg, router=router, logger=logger, env_fn=env_fn, echo=not args.quiet
+    )
+    ready = sup.start(wait_ready=True)
+    startup_s = round(time.time() - t_start, 1)
+    if ready < cfg.replicas:
+        sup.stop()
+        raise RuntimeError(f"only {ready}/{cfg.replicas} replicas became ready")
+
+    # ---- traffic: hot set of 8 cacheable tiles + unique cold misses -------
+    rng = np.random.default_rng(0)
+
+    def tile_body() -> bytes:
+        buf = io.BytesIO()
+        np.save(buf, rng.uniform(0, 1, (32, 32, 3)).astype(np.float32),
+                allow_pickle=False)
+        return buf.getvalue()
+
+    hot = [tile_body() for _ in range(8)]
+    cold_template = tile_body()
+    cold_data_off = len(cold_template) - 32 * 32 * 3 * 4
+
+    stop_load = threading.Event()
+    stop_stepped = threading.Event()
+    load = {"ok": 0, "errors": []}
+    load_lock = threading.Lock()
+
+    def client(i: int, stepped: bool) -> None:
+        import random as pyrandom
+
+        r = pyrandom.Random(i)
+        seq = 0
+        gate = stop_stepped if stepped else stop_load
+        while not gate.is_set():
+            if r.random() < 0.5:
+                body = hot[r.randrange(len(hot))]
+            else:
+                seq += 1
+                cold = bytearray(cold_template)
+                struct.pack_into("<ff", cold, cold_data_off,
+                                 float(i), float(seq))
+                body = bytes(cold)
+            status, _, payload = router.dispatch(body)
+            with load_lock:
+                if status >= 500:
+                    load["errors"].append(
+                        {"client": i, "status": status,
+                         "body": payload[:200].decode("utf-8", "replace")}
+                    )
+                else:
+                    load["ok"] += 1
+            if not stepped:
+                # Base load is gentle; the STEPPED clients are closed-loop
+                # with zero think time — that saturation is the pressure
+                # the scale-up thresholds exist for.
+                time.sleep(0.005)
+
+    timeline = {"t": [], "clients": [], "replicas": [], "ready": [],
+                "hit_rate": [], "phase": [], "queue_depth": [],
+                "slot_busy": []}
+    phase = {"name": "baseline"}
+    n_clients = {"n": base_clients}
+    stop_sampler = threading.Event()
+    t0 = time.perf_counter()
+
+    autoscaler = Autoscaler(
+        cfg, router, sup, logger=logger, registry=router.registry
+    )
+
+    def sampler() -> None:
+        while not stop_sampler.is_set():
+            stats = router.cache.stats()
+            sig = autoscaler._signals()
+            timeline["t"].append(round(time.perf_counter() - t0, 1))
+            timeline["clients"].append(n_clients["n"])
+            timeline["replicas"].append(sup.replica_count())
+            timeline["ready"].append(sup.ready_count())
+            timeline["hit_rate"].append(round(stats["cache_hit_rate"], 4))
+            timeline["phase"].append(phase["name"])
+            timeline["queue_depth"].append(round(sig["queue_depth"], 2))
+            timeline["slot_busy"].append(round(sig["slot_busy"], 3))
+            stop_sampler.wait(1.0)
+
+    threading.Thread(target=sampler, daemon=True).start()
+    autoscaler.start()
+
+    base_threads = [
+        threading.Thread(target=client, args=(i, False), daemon=True)
+        for i in range(base_clients)
+    ]
+    for t in base_threads:
+        t.start()
+
+    # ---- phase 1: baseline ------------------------------------------------
+    time.sleep(phase_s["baseline"])
+
+    # ---- phase 2: the 4x traffic step — scale-up must follow --------------
+    phase["name"] = "stepped"
+    stepped_threads = [
+        threading.Thread(target=client, args=(i, True), daemon=True)
+        for i in range(base_clients, stepped_clients)
+    ]
+    for t in stepped_threads:
+        t.start()
+    n_clients["n"] = stepped_clients
+    time.sleep(phase_s["stepped"])
+    replicas_at_peak = sup.replica_count()
+
+    # ---- phase 3: cache A/B — hits vs ?cache=bypass on the SAME tiles -----
+    phase["name"] = "cache_ab"
+    for body in hot:  # ensure every hot tile is resident
+        router.dispatch(body)
+    hit_ms, bypass_ms = [], []
+    for k in range(cache_requests):
+        ta = time.perf_counter()
+        router.dispatch(hot[k % len(hot)])
+        hit_ms.append((time.perf_counter() - ta) * 1e3)
+    for k in range(cache_requests):
+        ta = time.perf_counter()
+        router.dispatch(hot[k % len(hot)], query="cache=bypass")
+        bypass_ms.append((time.perf_counter() - ta) * 1e3)
+    cache_ab = {
+        "requests_per_arm": cache_requests,
+        "hit_p99_ms": _p99(hit_ms),
+        "bypass_p99_ms": _p99(bypass_ms),
+        "hit_p50_ms": round(sorted(hit_ms)[len(hit_ms) // 2], 3),
+        "bypass_p50_ms": round(sorted(bypass_ms)[len(bypass_ms) // 2], 3),
+        "hit_rate_overall": round(
+            router.cache.stats()["cache_hit_rate"], 4
+        ),
+    }
+
+    # ---- phase 4: corrupt rolling reload under the live autoscaler --------
+    # Settle barrier: rolling reload only touches LIVE replicas, so a
+    # still-warming scale-up would miss both the reload and its
+    # rollback and come up on the other step.  Wait until every
+    # managed replica is ready before pulling the trigger.
+    phase["name"] = "corrupt_reload"
+    settle_deadline = time.monotonic() + 120.0
+    while time.monotonic() < settle_deadline:
+        statuses = router.replica_status()
+        if statuses and all(s.get("ready") for s in statuses) and len(
+            statuses
+        ) >= sup.replica_count():
+            break
+        time.sleep(0.5)
+    make_tiny_run(workdir, seed=1, step=2)
+    r_reload = sup.rolling_reload()
+    reload_evidence = {
+        "ok": r_reload.get("ok"),
+        "aborted_on": r_reload.get("aborted_on"),
+        "reason": r_reload.get("reason"),
+        "rolled_back_to": r_reload.get("rolled_back_to"),
+        "rollback_clean": r_reload.get("rollback_clean"),
+    }
+
+    # ---- phase 5: step ends — scale-down must follow ----------------------
+    phase["name"] = "downscale"
+    stop_stepped.set()
+    for t in stepped_threads:
+        t.join(timeout=30)
+    n_clients["n"] = base_clients
+    time.sleep(phase_s["downscale"])
+
+    stop_load.set()
+    for t in base_threads:
+        t.join(timeout=30)
+    stop_sampler.set()
+    autoscaler.close()
+    snap = router.metrics.snapshot()
+    cache_stats = router.cache.stats()
+    fleet_health = router.healthz()
+    sup.stop()
+
+    # ---- audit ------------------------------------------------------------
+    jsonl = os.path.join(fleet_dir, "router.jsonl")
+    records = []
+    if os.path.exists(jsonl):
+        with open(jsonl) as f:
+            records = [json.loads(ln) for ln in f if ln.strip()]
+    autoscale_records = [r for r in records if r.get("kind") == "autoscale"]
+    scale_ups = [
+        r for r in autoscale_records
+        if r["action"] == "scale_up" and r.get("reason") != "below_min"
+    ]
+    scale_downs = [
+        r for r in autoscale_records if r["action"] == "scale_down"
+    ]
+    invalidations = [
+        r for r in records
+        if r.get("kind") == "router" and r.get("event") == "cache_invalidate"
+    ]
+    rollback_invalidations = [
+        r for r in invalidations if r.get("reason") == "reload_rollback"
+    ]
+    lint_violations = lint_stream(jsonl)
+    for rp in sup.replicas:
+        lint_violations += lint_stream(
+            os.path.join(rp.home, "serve_metrics.jsonl")
+        )
+
+    total = load["ok"] + len(load["errors"])
+    error_fraction = (len(load["errors"]) / total) if total else 1.0
+    budget = 1.0 - cfg.slo_availability
+
+    report = {
+        "schema": 1,
+        "host": {"cpus": os.cpu_count()},
+        "quick": bool(args.quick),
+        "startup_s": startup_s,
+        "step": {
+            "clients": f"{base_clients} -> {stepped_clients} (4x)",
+            "replicas_start": cfg.replicas,
+            "replicas_at_peak": replicas_at_peak,
+            "replicas_max_seen": max(timeline["replicas"]),
+            "replicas_final": timeline["replicas"][-1],
+        },
+        "load": {
+            "requests_ok": load["ok"],
+            "errors_5xx_count": len(load["errors"]),
+            "errors_5xx": load["errors"][:10],
+            "error_fraction": round(error_fraction, 5),
+            "error_budget": budget,
+        },
+        "cache_ab": cache_ab,
+        "cache_final": cache_stats,
+        "reload": reload_evidence,
+        "autoscale_decisions": [
+            {k: r.get(k) for k in
+             ("action", "reason", "replicas", "replicas_target", "replica",
+              "queue_depth", "slot_busy", "burn_rate")}
+            for r in autoscale_records
+            if r["action"] in ("scale_up", "scale_down")
+        ],
+        "cache_invalidations": [
+            {"reason": r.get("reason"), "dropped": r.get("dropped")}
+            for r in invalidations
+        ],
+        "timeline": timeline,
+        "router_metrics": snap,
+        "final_fleet": {
+            "ready": fleet_health["ready"],
+            "checkpoint_steps": fleet_health["checkpoint_steps"],
+        },
+        "quarantined_blobs": sorted(
+            n for n in os.listdir(os.path.join(workdir, "checkpoints"))
+            if n.endswith(".bad")
+        ),
+        "schema_lint_violations": lint_violations,
+        "wall_s": round(time.time() - t_start, 1),
+    }
+
+    survived = (
+        error_fraction <= budget
+        and len(scale_ups) >= 1
+        and max(timeline["replicas"]) > cfg.replicas
+        and len(scale_downs) >= 1
+        and timeline["replicas"][-1] < max(timeline["replicas"])
+        and cache_ab["hit_rate_overall"] > 0
+        and cache_ab["hit_p99_ms"] < cache_ab["bypass_p99_ms"]
+        and reload_evidence["ok"] is False
+        and bool(reload_evidence["rollback_clean"])
+        and len(rollback_invalidations) >= 1
+        and bool(report["quarantined_blobs"])
+        and report["final_fleet"]["checkpoint_steps"] == [1]
+        and lint_violations == 0
+    )
+    report["survived"] = bool(survived)
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workdir", default="/tmp/ddlpc_elastic_soak")
+    ap.add_argument("--out", default=None, help="write the report JSON here")
+    ap.add_argument("--quick", action="store_true", help="shorter phases")
+    ap.add_argument("--quiet", action="store_true")
+    ap.add_argument("--warmup-timeout-s", type=float, default=300.0)
+    args = ap.parse_args(argv)
+
+    report = run_soak(args)
+    out = json.dumps(report, indent=2)
+    print(out)
+    if args.out:
+        from ddlpc_tpu.utils.fsio import atomic_write_text
+
+        atomic_write_text(args.out, out + "\n")
+    # driver-contract line
+    print(
+        f"elastic_soak_survived={int(report['survived'])} "
+        f"errors_5xx={report['load']['errors_5xx_count']} "
+        f"replicas_peak={report['step']['replicas_max_seen']} "
+        f"cache_hit_p99_ms={report['cache_ab']['hit_p99_ms']} "
+        f"bypass_p99_ms={report['cache_ab']['bypass_p99_ms']}"
+    )
+    return 0 if report["survived"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -340,6 +340,340 @@ def run_fleet_load(
     }
 
 
+def run_cache_hit_load(
+    workdir: str,
+    clients: int,
+    requests: int,
+    tile: int,
+    max_batch: int,
+    max_wait_ms: float,
+    warmup_timeout_s: float = 300.0,
+    quantize: str = "bf16",
+    batcher: str = "continuous",
+) -> dict:
+    """Repeated-scene CACHE-HIT arm (perf_gate's ``cache_hit_p99_ms``):
+    a 1-replica fleet with the response cache on, a hot set of 8 tiles
+    pre-filled, then a closed-loop load where every request is a cache
+    hit.  The measured p99 is the router's full dispatch path minus the
+    replica round-trip — lookup, accounting, SLO observation — i.e. the
+    latency floor the cache buys on repeated scenes.  Gated so a lock
+    or hashing regression in the hot path cannot land silently.
+    """
+    import io
+
+    import numpy as np
+
+    from ddlpc_tpu.config import FleetConfig
+    from ddlpc_tpu.serve.fleet import ReplicaSupervisor
+    from ddlpc_tpu.serve.router import FleetRouter
+
+    cfg = FleetConfig(
+        workdir=workdir,
+        replicas=1,
+        max_batch=max_batch,
+        max_wait_ms=max_wait_ms,
+        queue_limit=max(4 * max_batch * clients, 64),
+        deadline_ms=0.0,
+        hedge_ms=0.0,
+        scrape_every_s=0.5,
+        warmup_timeout_s=warmup_timeout_s,
+        quantize=quantize,
+        batcher=batcher,
+        batch_queue_limit=max(4 * max_batch * clients, 256),
+        cache_max_bytes=64 << 20,
+    )
+
+    def env_fn(idx: int, launch: int):
+        env = dict(os.environ)
+        env.pop("DDLPC_CHAOS", None)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        return env
+
+    router = FleetRouter(cfg)
+    sup = ReplicaSupervisor(cfg, router=router, env_fn=env_fn, echo=False)
+    ready = sup.start(wait_ready=True)
+    if ready < 1:
+        sup.stop()
+        raise RuntimeError("replica never became ready")
+
+    rng = np.random.default_rng(0)
+
+    def tile_body() -> bytes:
+        buf = io.BytesIO()
+        np.save(
+            buf,
+            rng.uniform(0, 1, (tile, tile, 3)).astype(np.float32),
+            allow_pickle=False,
+        )
+        return buf.getvalue()
+
+    hot = [tile_body() for _ in range(8)]
+    router.scrape_once()  # absorb checkpoint_step → cache identity
+    for body in hot:  # fill pass: every hot tile cached
+        router.dispatch(body)
+    router.metrics.snapshot()  # reset — measure only the hit phase
+    hits_before = router.cache.stats()["cache_hits"]
+
+    per_client = max(requests // clients, 1)
+    errors = []
+
+    def client(i: int) -> None:
+        for k in range(per_client):
+            status, _, _ = router.dispatch(hot[(i + k) % len(hot)])
+            if status >= 500:
+                errors.append(status)
+
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t0
+    snap = router.metrics.snapshot()
+    stats = router.cache.stats()
+    sup.stop()
+
+    p99 = snap["p99_ms"]
+    return {
+        "metric": "cache_hit_p99_ms",
+        "value": p99,
+        "unit": "ms",
+        "p50_ms": snap["p50_ms"],
+        "p95_ms": snap["p95_ms"],
+        "requests": snap["requests"],
+        "hit_requests": stats["cache_hits"] - hits_before,
+        "cache_hit_rate": round(stats["cache_hit_rate"], 4),
+        "bench_errors": len(errors),
+        "clients": clients,
+        "wall_s": round(wall_s, 3),
+    }
+
+
+def parse_step_load(spec: str):
+    """``A:B:T`` → (start clients, stepped clients, step time seconds)."""
+    try:
+        a, b, t = spec.split(":")
+        a, b, t = int(a), int(b), float(t)
+    except ValueError:
+        raise SystemExit(f"--step-load takes A:B:T (e.g. 1:8:10), got {spec!r}")
+    if a < 1 or b < 1 or t <= 0:
+        raise SystemExit(f"--step-load values must be positive, got {spec!r}")
+    return a, b, t
+
+
+def run_step_load(
+    workdir: str,
+    start_clients: int,
+    stepped_clients: int,
+    step_at_s: float,
+    duration_s: float,
+    replicas: int,
+    max_replicas: int,
+    tile: int,
+    max_batch: int,
+    max_wait_ms: float,
+    warmup_timeout_s: float = 300.0,
+    quantize: str = "bf16",
+    batcher: str = "continuous",
+) -> dict:
+    """``--step-load A:B:T`` arm: a traffic step-function against an
+    ELASTIC fleet — autoscaler on (min=``replicas``, max
+    ``max_replicas``), response cache on, client count stepping A→B at
+    T seconds.  The result carries a once-per-second timeline of client
+    count / supervised replicas / ready replicas / cache hit-rate, so
+    "replica count follows load" is reproducible from one command —
+    this is how docs/resilience/elastic_soak.json's step phase is made.
+
+    Traffic is repeated-scene shaped: half the requests draw from a hot
+    set of 8 tiles (cacheable repeats), half are UNIQUE cold tiles (a
+    per-request nonce patched into the tile bytes) — hit-rate stays > 0
+    while every miss still reaches a replica, so the scale-up pressure
+    is real.  A finite cold pool would not work: it fills the cache
+    after one pass and the fleet idles behind a ~100% hit rate.
+
+    Driver contract: the caller prints ONE JSON line with
+    ``{"metric": "fleet_p99_ms", ...}`` (timeline fields are flat lists).
+    """
+    import io
+    import random as pyrandom
+
+    import numpy as np
+
+    from ddlpc_tpu.config import FleetConfig
+    from ddlpc_tpu.serve.autoscale import Autoscaler
+    from ddlpc_tpu.serve.fleet import ReplicaSupervisor
+    from ddlpc_tpu.serve.router import FleetRouter
+
+    clients_hi = max(start_clients, stepped_clients)
+    cfg = FleetConfig(
+        workdir=workdir,
+        replicas=replicas,
+        max_batch=max_batch,
+        max_wait_ms=max_wait_ms,
+        queue_limit=max(4 * max_batch * clients_hi, 64),
+        deadline_ms=0.0,
+        hedge_ms=0.0,
+        scrape_every_s=0.5,
+        warmup_timeout_s=warmup_timeout_s,
+        quantize=quantize,
+        batcher=batcher,
+        batch_queue_limit=max(4 * max_batch * clients_hi, 256),
+        # the elastic subsystem under test:
+        autoscale_enabled=True,
+        autoscale_min_replicas=replicas,
+        autoscale_max_replicas=max_replicas,
+        autoscale_interval_s=1.0,
+        autoscale_cooldown_s=5.0,
+        autoscale_queue_depth_high=2.0,  # CPU replicas saturate shallow
+        autoscale_queue_depth_low=0.5,
+        cache_max_bytes=64 << 20,
+    )
+
+    def env_fn(idx: int, launch: int):
+        env = dict(os.environ)
+        env.pop("DDLPC_CHAOS", None)  # the bench is chaos-free
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        return env
+
+    router = FleetRouter(cfg)
+    sup = ReplicaSupervisor(cfg, router=router, env_fn=env_fn, echo=False)
+    t_start = time.perf_counter()
+    ready = sup.start(wait_ready=True)
+    startup_s = time.perf_counter() - t_start
+    if ready < replicas:
+        sup.stop()
+        raise RuntimeError(f"only {ready}/{replicas} replicas became ready")
+
+    rng = np.random.default_rng(0)
+
+    def tile_body(seed_rng) -> bytes:
+        buf = io.BytesIO()
+        np.save(
+            buf,
+            seed_rng.uniform(0, 1, (tile, tile, 3)).astype(np.float32),
+            allow_pickle=False,
+        )
+        return buf.getvalue()
+
+    hot = [tile_body(rng) for _ in range(8)]
+    # Cold template: misses are made unique by patching (client, seq) into
+    # the first two floats of the payload — cheaper than re-serializing a
+    # fresh array per request, and structurally a valid tile.
+    cold_template = tile_body(rng)
+    cold_data_off = len(cold_template) - tile * tile * 3 * 4
+
+    # Warm the routed path (and the cache identity) before timing.
+    router.dispatch(hot[0])
+    router.scrape_once()
+    router.metrics.snapshot()
+
+    autoscaler = Autoscaler(cfg, router, sup, registry=router.registry)
+    autoscaler.start()
+
+    stop = threading.Event()
+    errors = []
+    sent = [0] * clients_hi
+    active = {"n": start_clients}
+
+    def client(i: int) -> None:
+        import struct
+
+        r = pyrandom.Random(i)
+        seq = 0
+        while not stop.is_set():
+            if r.random() < 0.5:
+                body = r.choice(hot)
+            else:
+                seq += 1
+                cold = bytearray(cold_template)
+                struct.pack_into(
+                    "<ff", cold, cold_data_off, float(i), float(seq)
+                )
+                body = bytes(cold)
+            status, _, _ = router.dispatch(body)
+            sent[i] += 1
+            if status >= 500:
+                errors.append(status)
+
+    timeline = {
+        "t": [], "clients": [], "replicas": [], "ready": [], "hit_rate": [],
+    }
+
+    def sample(now_s: float) -> None:
+        stats = router.cache.stats()
+        timeline["t"].append(round(now_s, 1))
+        timeline["clients"].append(active["n"])
+        timeline["replicas"].append(sup.replica_count())
+        timeline["ready"].append(sup.ready_count())
+        timeline["hit_rate"].append(round(stats["cache_hit_rate"], 4))
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(clients_hi)
+    ]
+    t0 = time.perf_counter()
+    for t in threads[:start_clients]:
+        t.start()
+    stepped = False
+    while True:
+        now_s = time.perf_counter() - t0
+        if now_s >= duration_s:
+            break
+        if not stepped and now_s >= step_at_s:
+            for t in threads[start_clients:]:
+                t.start()
+            active["n"] = stepped_clients
+            stepped = True
+        sample(now_s)
+        time.sleep(1.0)
+    stop.set()
+    for t in threads[: active["n"]]:
+        t.join(timeout=30)
+    wall_s = time.perf_counter() - t0
+    autoscaler.close()
+    snap = router.metrics.snapshot()
+    cache_stats = router.cache.stats()
+    sup.stop()
+
+    p99 = snap["p99_ms"]
+    total = sum(sent)
+    return {
+        "metric": "fleet_p99_ms",
+        "value": p99,
+        "unit": "ms",
+        "vs_baseline": (
+            round(BASELINE_P99_MS / p99, 3) if p99 else None
+        ),
+        "p50_ms": snap["p50_ms"],
+        "p95_ms": snap["p95_ms"],
+        "requests": snap["requests"],
+        "requests_per_sec": round(total / wall_s, 3) if wall_s else None,
+        "errors_5xx": snap["errors_5xx"],
+        "retries": snap["retries"],
+        "bench_errors": len(errors),
+        "step_load": f"{start_clients}:{stepped_clients}:{step_at_s:g}",
+        "replicas_min": replicas,
+        "replicas_max": max_replicas,
+        "replicas_final": timeline["replicas"][-1] if timeline["replicas"] else replicas,
+        "cache_hit_rate": round(cache_stats["cache_hit_rate"], 4),
+        "cache_hits": cache_stats["cache_hits"],
+        "cache_misses": cache_stats["cache_misses"],
+        "timeline_t": timeline["t"],
+        "timeline_clients": timeline["clients"],
+        "timeline_replicas": timeline["replicas"],
+        "timeline_ready": timeline["ready"],
+        "timeline_hit_rate": timeline["hit_rate"],
+        "startup_s": round(startup_s, 1),
+        "wall_s": round(wall_s, 3),
+        "max_batch": max_batch,
+        "quantize": quantize,
+        "batcher": batcher,
+    }
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument(
@@ -381,9 +715,36 @@ def main() -> int:
         help="interactive:bulk client ratio (e.g. 3:1); bulk clients "
         "send priority=batch requests",
     )
+    p.add_argument(
+        "--step-load", metavar="A:B:T",
+        help="elastic-fleet arm: closed-loop client count steps A→B at "
+        "T seconds against an autoscaling fleet with the response cache "
+        "on; emits the fleet_p99_ms line plus cache hit-rate and a "
+        "replica-count timeline",
+    )
+    p.add_argument(
+        "--duration", type=float, default=0.0,
+        help="(--step-load) total load seconds (default: 2×T + 10)",
+    )
+    p.add_argument(
+        "--max-replicas", type=int, default=4,
+        help="(--step-load) autoscaler ceiling; the floor is --fleet "
+        "(default 1)",
+    )
     args = p.parse_args()
 
     def run(workdir: str) -> dict:
+        if args.step_load:
+            a, b, t = parse_step_load(args.step_load)
+            duration = args.duration or (2 * t + 10)
+            return run_step_load(
+                workdir, a, b, t, duration,
+                replicas=max(args.fleet, 1),
+                max_replicas=args.max_replicas,
+                tile=args.tile, max_batch=args.max_batch,
+                max_wait_ms=args.max_wait_ms,
+                quantize=args.quantize, batcher=args.batcher,
+            )
         if args.fleet > 0:
             return run_fleet_load(
                 workdir, args.fleet, args.clients, args.requests,
@@ -403,7 +764,10 @@ def main() -> int:
     else:
         with tempfile.TemporaryDirectory() as tmp:
             workdir = os.path.join(tmp, "serve_bench_run")
-            make_tiny_run(workdir, tile=args.tile if args.fleet else 32)
+            make_tiny_run(
+                workdir,
+                tile=args.tile if (args.fleet or args.step_load) else 32,
+            )
             result = run(workdir)
     print(json.dumps(result))
     return 0

@@ -1,0 +1,277 @@
+"""Autoscaler policy against fake clock/router/supervisor (ISSUE 16):
+scale-up on each pressure signal, scale-down preferring breaker-open
+replicas, cooldown suppressing flapping, and the min/max bounds holding
+absolutely.  No threads, no sleeps — the policy is a pure function of
+(signals, count, clock) and these tests pin it as one."""
+
+from ddlpc_tpu.config import FleetConfig
+from ddlpc_tpu.obs import schema
+from ddlpc_tpu.serve.autoscale import Autoscaler
+
+
+def _status(name, *, queue=0, slot_busy=0.0, breaker="closed",
+            healthy=True, ready=True, draining=False):
+    return {
+        "name": name,
+        "ready": ready,
+        "healthy": healthy,
+        "draining": draining,
+        "breaker": breaker,
+        "queue_depth_interactive": queue,
+        "slot_busy": slot_busy,
+    }
+
+
+class FakeSLO:
+    def __init__(self):
+        self.burn = 0.0
+        self.windows = []
+
+    def burn_rate(self, priority, window_s):
+        self.windows.append((priority, window_s))
+        return self.burn
+
+
+class FakeRouterView:
+    def __init__(self, statuses=None):
+        self.slo = FakeSLO()
+        self.statuses = statuses or []
+
+    def replica_status(self):
+        return list(self.statuses)
+
+
+class FakeSupervisor:
+    def __init__(self, n=2):
+        self.n = n
+        self.ups = 0
+        self.downs = []
+
+    def replica_count(self):
+        return self.n
+
+    def scale_up(self):
+        self.n += 1
+        self.ups += 1
+        return f"r{self.n - 1}"
+
+    def scale_down(self, name):
+        self.n -= 1
+        self.downs.append(name)
+        return True
+
+
+class CaptureLogger:
+    def __init__(self):
+        self.records = []
+
+    def log(self, record, echo=True):
+        self.records.append(dict(record))
+
+
+def make_autoscaler(statuses, n=2, logger=None, **cfg_kw):
+    cfg_kw.setdefault("autoscale_min_replicas", 1)
+    cfg_kw.setdefault("autoscale_max_replicas", 4)
+    cfg_kw.setdefault("autoscale_cooldown_s", 30.0)
+    cfg_kw.setdefault("autoscale_burn_threshold", 2.0)
+    cfg_kw.setdefault("autoscale_queue_depth_high", 8.0)
+    cfg_kw.setdefault("autoscale_queue_depth_low", 1.0)
+    cfg_kw.setdefault("autoscale_slot_busy_high", 0.85)
+    cfg_kw.setdefault("autoscale_slot_busy_low", 0.30)
+    cfg = FleetConfig(**cfg_kw)
+    router = FakeRouterView(statuses)
+    sup = FakeSupervisor(n)
+    clock = {"t": 0.0}
+    a = Autoscaler(cfg, router, sup, logger=logger,
+                   clock=lambda: clock["t"])
+    return a, router, sup, clock
+
+
+# ---- scale-up triggers ------------------------------------------------------
+
+
+def test_scale_up_on_burn_rate():
+    a, router, sup, _ = make_autoscaler(
+        [_status("r0"), _status("r1")]
+    )
+    router.slo.burn = 5.0
+    assert a.evaluate() == "scale_up"
+    assert sup.n == 3
+    # the burn signal was read on the configured fast window
+    assert router.slo.windows[0] == ("interactive", a.cfg.slo_fast_window_s)
+
+
+def test_scale_up_on_queue_depth():
+    a, _, sup, _ = make_autoscaler(
+        [_status("r0", queue=10), _status("r1", queue=12)]
+    )
+    assert a.evaluate() == "scale_up"
+    assert sup.n == 3
+
+
+def test_scale_up_on_slot_busy():
+    a, _, sup, _ = make_autoscaler(
+        [_status("r0", slot_busy=0.95), _status("r1", slot_busy=0.2)]
+    )
+    assert a.evaluate() == "scale_up"  # MAX across replicas triggers
+    assert sup.n == 3
+
+
+def test_unhealthy_replicas_do_not_feed_signals():
+    # a warming/unhealthy replica's (absent) queue must not gate policy
+    a, _, sup, _ = make_autoscaler(
+        [_status("r0", queue=10), _status("r1", queue=0, healthy=False)]
+    )
+    assert a.evaluate() == "scale_up"  # mean over READY+healthy = 10
+    assert sup.n == 3
+
+
+# ---- bounds + cooldown ------------------------------------------------------
+
+
+def test_max_bound_holds():
+    a, router, sup, _ = make_autoscaler(
+        [_status("r0")], n=4, autoscale_max_replicas=4
+    )
+    router.slo.burn = 99.0
+    assert a.evaluate() == "suppressed_max"
+    assert sup.n == 4 and sup.ups == 0
+
+
+def test_cooldown_suppresses_flapping():
+    a, router, sup, clock = make_autoscaler(
+        [_status("r0"), _status("r1")], autoscale_cooldown_s=30.0
+    )
+    router.slo.burn = 5.0
+    assert a.evaluate() == "scale_up"
+    clock["t"] = 5.0
+    assert a.evaluate() == "suppressed_cooldown"
+    assert sup.n == 3  # only the first action landed
+    clock["t"] = 31.0
+    assert a.evaluate() == "scale_up"
+    assert sup.n == 4
+
+
+def test_min_bound_holds_when_idle():
+    a, _, sup, _ = make_autoscaler(
+        [_status("r0")], n=1, autoscale_min_replicas=1
+    )
+    # everything idle: scale-down is warranted but the floor holds,
+    # quietly (steady state, not a decision).
+    assert a.evaluate() is None
+    assert sup.n == 1 and sup.downs == []
+
+
+def test_below_min_restores_even_during_cooldown():
+    a, router, sup, clock = make_autoscaler(
+        [_status("r0"), _status("r1")], n=2, autoscale_min_replicas=2
+    )
+    router.slo.burn = 5.0
+    assert a.evaluate() == "scale_up"  # starts the cooldown window
+    clock["t"] = 1.0
+    sup.n = 1  # a replica gave up below the floor
+    assert a.evaluate() == "scale_up"
+    assert sup.n == 2
+
+
+# ---- scale-down -------------------------------------------------------------
+
+
+def test_scale_down_prefers_breaker_open_replica():
+    a, _, sup, _ = make_autoscaler(
+        [
+            _status("r0", breaker="open"),
+            _status("r1"),
+            _status("r2"),
+        ],
+        n=3,
+    )
+    assert a.evaluate() == "scale_down"
+    assert sup.downs == ["r0"]
+
+
+def test_scale_down_falls_back_to_highest_index():
+    a, _, sup, _ = make_autoscaler(
+        [_status("r0"), _status("r1"), _status("r2")], n=3
+    )
+    assert a.evaluate() == "scale_down"
+    assert sup.downs == ["r2"]  # LIFO keeps the original fleet shape
+
+
+def test_scale_down_requires_every_signal_low():
+    # one signal above its LOW water mark blocks scale-down entirely
+    a, _, sup, _ = make_autoscaler(
+        [_status("r0", slot_busy=0.5), _status("r1")], n=2
+    )
+    assert a.evaluate() is None
+    assert sup.downs == []
+
+
+def test_collapsed_fleet_is_not_mistaken_for_idle():
+    # zero ready replicas zeroes every pressure signal — exactly the
+    # shape of "idle".  Scale-down here would retire capacity in the
+    # middle of an outage; the policy must hold instead.
+    a, _, sup, _ = make_autoscaler(
+        [_status("r0", healthy=False), _status("r1", healthy=False)], n=2
+    )
+    assert a.evaluate() is None
+    assert sup.downs == []
+
+
+def test_scale_down_skips_draining_replicas():
+    a, _, sup, _ = make_autoscaler(
+        [_status("r0", draining=True), _status("r1")], n=2
+    )
+    assert a.evaluate() == "scale_down"
+    assert sup.downs == ["r1"]
+
+
+# ---- the decision ledger ----------------------------------------------------
+
+
+def test_decisions_are_flat_registered_jsonl_records():
+    logger = CaptureLogger()
+    a, router, sup, clock = make_autoscaler(
+        [_status("r0", queue=3), _status("r1", queue=5)], logger=logger
+    )
+    router.slo.burn = 5.0
+    a.evaluate()
+    clock["t"] = 1.0
+    a.evaluate()  # suppressed_cooldown — suppressions are recorded too
+    assert [r["action"] for r in logger.records] == [
+        "scale_up", "suppressed_cooldown",
+    ]
+    up = logger.records[0]
+    # triggering signal values ride every record
+    assert up["reason"] == "burn_rate"
+    assert up["burn_rate"] == 5.0
+    assert up["queue_depth"] == 4.0
+    assert up["replicas"] == 2 and up["replicas_target"] == 3
+    for rec in logger.records:
+        stamped = schema.stamp(dict(rec), kind="autoscale")
+        assert schema.check_record(stamped) == []
+
+
+def test_quiet_hold_emits_nothing():
+    logger = CaptureLogger()
+    a, _, sup, _ = make_autoscaler(
+        [_status("r0", queue=2)], n=1, logger=logger,
+        autoscale_min_replicas=1,
+    )
+    # between the low and high water marks: no action either way
+    assert a.evaluate() is None
+    assert logger.records == []
+
+
+def test_missing_slo_tracker_is_not_a_trigger():
+    class NoSLORouter:
+        slo = None
+
+        def replica_status(self):
+            return [_status("r0")]
+
+    cfg = FleetConfig(autoscale_min_replicas=1, autoscale_max_replicas=4)
+    sup = FakeSupervisor(2)
+    a = Autoscaler(cfg, NoSLORouter(), sup, clock=lambda: 0.0)
+    assert a.evaluate() in (None, "scale_down")  # never a burn scale-up
+    assert sup.ups == 0

@@ -1,0 +1,197 @@
+"""Content-addressed response cache (ISSUE 16): unit behavior (keying,
+byte-bounded LRU, invalidation) and its router integration (hits are
+byte-identical and skip the replica, ?cache=bypass is honored, a serving
+step change flushes fleet-wide).  Reuses test_router's fake-replica
+harness — no jax, no subprocesses."""
+
+from ddlpc_tpu.config import FleetConfig
+from ddlpc_tpu.obs import schema
+from ddlpc_tpu.serve.cache import ResponseCache, response_key
+from ddlpc_tpu.serve.router import FleetRouter
+
+from tests.test_router import FakeReplica
+
+OK_CTYPE = "application/x-npy"
+
+
+# ---- keying -----------------------------------------------------------------
+
+
+def test_key_covers_body_step_and_quant():
+    base = response_key(b"tile", 5, "off")
+    assert response_key(b"tile", 5, "off") == base  # deterministic
+    assert response_key(b"tilf", 5, "off") != base
+    assert response_key(b"tile", 6, "off") != base
+    assert response_key(b"tile", 5, "int8") != base
+
+
+# ---- LRU by bytes -----------------------------------------------------------
+
+
+def test_hit_returns_the_exact_stored_response():
+    c = ResponseCache(1024)
+    resp = (200, OK_CTYPE, b"\x01\x02logits")
+    k = response_key(b"tile", 1, "off")
+    assert c.put(k, resp)
+    assert c.get(k) == resp  # byte-identical triple
+
+def test_lru_evicts_by_bytes_oldest_first():
+    c = ResponseCache(100)
+    ka = response_key(b"a", 1, "off")
+    kb = response_key(b"b", 1, "off")
+    kc = response_key(b"c", 1, "off")
+    c.put(ka, (200, OK_CTYPE, b"a" * 40))
+    c.put(kb, (200, OK_CTYPE, b"b" * 40))
+    c.get(ka)  # touch a → b is now LRU
+    c.put(kc, (200, OK_CTYPE, b"c" * 40))  # 120 bytes > 100 → evict b
+    assert c.get(ka) is not None
+    assert c.get(kb) is None
+    assert c.get(kc) is not None
+    s = c.stats()
+    assert s["cache_evictions"] == 1
+    assert s["cache_bytes"] <= 100
+
+
+def test_oversized_and_error_responses_are_not_cached():
+    c = ResponseCache(10)
+    assert not c.put("k1", (200, OK_CTYPE, b"x" * 11))  # > max_bytes
+    assert not c.put("k2", (503, OK_CTYPE, b"shed"))  # not a 200
+    assert c.stats()["cache_entries"] == 0
+
+
+def test_disabled_cache_is_a_noop():
+    c = ResponseCache(0)
+    assert not c.enabled
+    assert not c.put("k", (200, OK_CTYPE, b"x"))
+    assert c.get("k") is None
+
+
+def test_invalidate_drops_everything():
+    c = ResponseCache(1024)
+    c.put("k1", (200, OK_CTYPE, b"x"))
+    c.put("k2", (200, OK_CTYPE, b"y"))
+    assert c.invalidate("reload") == 2
+    assert c.stats()["cache_entries"] == 0
+    assert c.stats()["cache_bytes"] == 0
+    assert c.stats()["cache_invalidations"] == 1
+    assert c.invalidate("reload") == 0  # empty flush isn't counted twice
+    assert c.stats()["cache_invalidations"] == 1
+
+
+# ---- router integration -----------------------------------------------------
+
+
+def make_cached_router(replicas, **cfg_kw):
+    cfg_kw.setdefault("cache_max_bytes", 1 << 20)
+    cfg_kw.setdefault("hedge_ms", 0.0)
+    cfg_kw.setdefault("retry_backoff_ms", 0.0)
+    cfg_kw.setdefault("scrape_every_s", 0.0)
+    cfg_kw.setdefault("metrics_every_s", 0.0)
+    router = FleetRouter(FleetConfig(**cfg_kw))
+    for r in replicas:
+        router.add_replica(r.name, r)
+    router.scrape_once()  # absorb checkpoint_step/quant → cache identity
+    return router
+
+
+def test_repeat_request_hits_and_is_byte_identical():
+    payloads = [b"logits-call-0", b"logits-call-1"]
+    r = FakeReplica("r0", behavior=lambda i: (200, OK_CTYPE, payloads[i]))
+    router = make_cached_router([r])
+    first = router.dispatch(b"tile")
+    second = router.dispatch(b"tile")
+    assert first == second == (200, OK_CTYPE, b"logits-call-0")
+    assert r.calls == 1  # the repeat never reached the replica
+    stats = router.cache.stats()
+    assert stats["cache_hits"] == 1 and stats["cache_misses"] == 1
+    # hits are answered requests: both feed the router ledger
+    assert router.metrics.snapshot()["requests"] == 2
+
+
+def test_bypass_knob_skips_lookup_and_fill():
+    r = FakeReplica("r0")
+    router = make_cached_router([r])
+    router.dispatch(b"tile", query="cache=bypass")
+    router.dispatch(b"tile", query="cache=bypass")
+    assert r.calls == 2  # both routed
+    stats = router.cache.stats()
+    assert stats["cache_entries"] == 0  # no fill either
+    assert stats["cache_hits"] == 0 and stats["cache_misses"] == 0
+
+
+def test_different_bodies_do_not_collide():
+    r = FakeReplica("r0", behavior=lambda i: (200, OK_CTYPE, b"p%d" % i))
+    router = make_cached_router([r])
+    a = router.dispatch(b"tile-a")
+    b = router.dispatch(b"tile-b")
+    assert a[2] != b[2]
+    assert r.calls == 2
+
+
+def test_step_change_invalidates_fleet_wide():
+    r = FakeReplica("r0")
+    router = make_cached_router([r])
+    router.dispatch(b"tile")
+    assert router.cache.stats()["cache_entries"] == 1
+    # the fleet reloads: the scraped step moves
+    r.health["checkpoint_step"] = 2
+    router.scrape_once()
+    router.dispatch(b"tile")
+    stats = router.cache.stats()
+    assert stats["cache_invalidations"] == 1  # step change flushed
+    assert r.calls == 2  # the repeat recomputed on the new step
+
+
+def test_supervisor_invalidation_hook_flushes_and_logs():
+    class CaptureLogger:
+        def __init__(self):
+            self.records = []
+
+        def log(self, record, echo=True):
+            self.records.append(dict(record))
+
+    logger = CaptureLogger()
+    r = FakeReplica("r0")
+    router = make_cached_router([r])
+    router.logger = logger
+    router.dispatch(b"tile")
+    dropped = router.invalidate_cache("reload_rollback")
+    assert dropped == 1
+    assert router.cache.stats()["cache_entries"] == 0
+    events = [
+        rec for rec in logger.records
+        if rec.get("event") == "cache_invalidate"
+    ]
+    assert events and events[0]["reason"] == "reload_rollback"
+    # a repeat after the flush recomputes
+    router.dispatch(b"tile")
+    assert r.calls == 2
+
+
+def test_mixed_steps_pause_caching():
+    a = FakeReplica("a", health={"checkpoint_step": 1})
+    b = FakeReplica("b", health={"checkpoint_step": 2})
+    router = make_cached_router([a, b])
+    router.dispatch(b"tile")
+    router.dispatch(b"tile")
+    # mid-rolling-reload: no consensus identity → nothing cached, every
+    # request routed
+    assert router.cache.stats()["cache_entries"] == 0
+    assert a.calls + b.calls == 2
+
+
+def test_cache_stats_record_is_flat_and_registered():
+    r = FakeReplica("r0")
+    router = make_cached_router([r])
+    router.dispatch(b"tile")
+    rec = schema.stamp(dict(router.cache.stats()), kind="cache")
+    assert schema.check_record(rec) == []
+
+
+def test_cache_off_router_never_touches_it():
+    r = FakeReplica("r0")
+    router = make_cached_router([r], cache_max_bytes=0)
+    router.dispatch(b"tile")
+    router.dispatch(b"tile")
+    assert r.calls == 2
+    assert router.cache.stats()["cache_misses"] == 0

@@ -635,3 +635,106 @@ def test_retry_waits_out_transient_no_replica_window():
     assert (status, body) == (200, b"ok")
     assert r1.calls == 1  # the retry landed on the readmitted replica
     assert router.metrics.snapshot()["errors_5xx"] == 0
+
+
+# ---- warming replicas never feed breakers (ISSUE 16) ------------------------
+
+
+class WarmingReplica(FakeReplica):
+    """Mid-launch replica: nothing is listening yet, so every scrape and
+    attempt fails with a wrapped ConnectionRefusedError — exactly what
+    HTTPReplicaClient raises while a scale-up races warmup."""
+
+    def __init__(self, name):
+        super().__init__(name)
+        self.up = False
+
+    def _refuse(self):
+        try:
+            raise ConnectionRefusedError(111, "Connection refused")
+        except ConnectionRefusedError as e:
+            raise ReplicaError(f"{self.name}: ConnectionRefusedError") from e
+
+    def healthz(self, timeout_s):
+        if not self.up:
+            self._refuse()
+        return super().healthz(timeout_s)
+
+    def predict(self, body, query, timeout_s, cancel=None):
+        if not self.up:
+            self._refuse()
+        return super().predict(body, query, timeout_s, cancel=cancel)
+
+
+def test_warming_replica_scrape_refused_is_ineligible_without_breaker():
+    """Regression pin: a replica mid-launch (connection refused on the
+    /healthz scrape) leaves rotation IMMEDIATELY — not after
+    unhealthy_after strikes — and its breaker records nothing."""
+    warm = WarmingReplica("warm")
+    ok = FakeReplica("ok")
+    router = make_router([warm, ok], unhealthy_after=3)
+    router.scrape_once()  # ONE refused scrape, not unhealthy_after
+    status = {s["name"]: s for s in router.replica_status()}
+    assert status["warm"]["healthy"] is False
+    assert status["warm"]["breaker"] == "closed"
+    for _ in range(4):
+        assert router.dispatch(b"img")[0] == 200
+    assert warm.calls == 0 and ok.calls == 4
+    # nothing was ever recorded against the warming replica's breaker
+    breaker = router._replicas["warm"].breaker
+    assert breaker.state == "closed" and len(breaker._outcomes) == 0
+    assert router.metrics.snapshot()["breaker_opens"] == 0
+    # ...and once it comes up, one good scrape restores eligibility
+    warm.up = True
+    router.scrape_once()
+    status = {s["name"]: s for s in router.replica_status()}
+    assert status["warm"]["healthy"] is True
+    while warm.calls == 0:
+        router.dispatch(b"img")
+    assert warm.calls >= 1
+
+
+def test_warming_replica_attempt_refused_is_breaker_neutral():
+    """The dispatch path mirrors the scrape path: an attempt refused by a
+    never-ready replica retries elsewhere and is NEUTRAL for the breaker
+    (released, not recorded) — repeated dispatches during warmup must not
+    open it."""
+    warm = WarmingReplica("warm")
+    ok = FakeReplica("ok")
+    # breaker tuned so 2 recorded failures would open it
+    router = make_router(
+        [warm, ok], retries=2,
+        breaker_window=4, breaker_min_samples=2, breaker_error_rate=0.4,
+    )
+    for _ in range(6):
+        status, _, body = router.dispatch(b"img")
+        assert (status, body) == (200, b"ok")
+    breaker = router._replicas["warm"].breaker
+    assert breaker.state == "closed" and len(breaker._outcomes) == 0
+    assert router.metrics.snapshot()["breaker_opens"] == 0
+    # the refused attempt also took it out of rotation until a scrape
+    status = {s["name"]: s for s in router.replica_status()}
+    assert status["warm"]["healthy"] is False
+
+
+def test_refused_after_first_success_still_feeds_the_breaker():
+    """The warming grace is ONLY for replicas that never answered: once a
+    replica has served, a refused connection is a real failure (process
+    died mid-flight) and must count toward opening its breaker."""
+    warm = WarmingReplica("warm")
+    warm.up = True
+    router = make_router(
+        [warm], retries=0,
+        breaker_window=4, breaker_min_samples=2, breaker_error_rate=0.4,
+        no_replica_wait_ms=0.0,
+    )
+    router.scrape_once()  # successful: the grace window closes
+    assert router.dispatch(b"img")[0] == 200
+    warm.up = False  # the process dies; connections now refused
+    router.dispatch(b"img")
+    router.dispatch(b"img")
+    # both refusals were RECORDED (not released): enough to trip the
+    # breaker open at min_samples=2
+    breaker = router._replicas["warm"].breaker
+    assert breaker.state == "open"
+    assert router.metrics.snapshot()["breaker_opens"] == 1
