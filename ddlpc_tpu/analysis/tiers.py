@@ -61,6 +61,10 @@ MODULE_TIERS: Dict[str, str] = {
     # routing tier they serve.
     "ddlpc_tpu.obs.merge": STDLIB,
     "ddlpc_tpu.obs.aggregate": STDLIB,
+    # lineage (ISSUE 17): checkpoint provenance records.  Stdlib by
+    # charter — the jax-free router tier reads checkpoint sidecars
+    # through it for the model-age gauge.
+    "ddlpc_tpu.obs.lineage": STDLIB,
     # resilience: the supervisor must restart a crashed trainer without
     # importing what crashed it.
     "ddlpc_tpu.resilience": STDLIB,

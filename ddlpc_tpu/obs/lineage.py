@@ -1,0 +1,194 @@
+"""Model lineage: the provenance record a checkpoint carries to serving.
+
+The paper's correctness story is bit-identical replicated training — so
+"which training run and step produced these logits?" must be answerable
+for every served response.  A **lineage record** is stamped into each
+checkpoint at save (manifest v3 + sidecar), rides restore →
+``serve/engine`` reload → ``/healthz`` → the ``X-DDLPC-Model-Step``
+response header → router spans and cache keys, and keys the merged
+train→serve timeline in ``obs/merge.py``.
+
+The record (a small dict — nested form lives only in manifests/sidecars
+and HTTP JSON; JSONL streams carry its fields FLAT per the schema.py
+contract):
+
+- ``lineage_id``   16-hex id unique to one (run, save) — the join key;
+- ``run_id``       16-hex id unique to one Trainer construction;
+- ``step``         the optimizer step the checkpoint snapshots;
+- ``config_hash``  sha256[:16] of the experiment config JSON;
+- ``fingerprint``  sha256[:16] over the package's own source tree — the
+  git-sha-equivalent for deployments without a ``.git``;
+- ``saved_at``     wall-clock seconds when the save was stamped (the
+  anchor for ``ddlpc_serve_model_age_s`` / ``ddlpc_deploy_latency_s``).
+
+Checkpoints that predate lineage (v1 monolithic, v2 ``.dwc``) degrade to
+:func:`unknown_lineage` — an explicit ``lineage_unknown`` marker in every
+field, NEVER a crash and never a silent absence: downstream gauges skip
+unknown replicas instead of reporting a fake age.
+
+Stdlib-only by charter (analysis/tiers.py): the router's freshness gauge
+reads checkpoint sidecars via :func:`newest_checkpoint_lineage` without
+importing the jax-tier checkpoint reader.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import time
+import uuid
+from typing import Optional
+
+# The explicit degradation marker.  String-typed on purpose: it shows up
+# verbatim in healthz payloads, response headers and gauges' absence
+# logic, so "we do not know" is distinguishable from any real value.
+LINEAGE_UNKNOWN = "lineage_unknown"
+
+# Response header carrying the serving checkpoint step end-to-end
+# (replica -> router -> fleet front door), so a client — and the prod
+# soak's sampler — can attribute any response to a training step.
+MODEL_STEP_HEADER = "X-DDLPC-Model-Step"
+
+# The fields every lineage record carries (schema for docs + tests).
+LINEAGE_FIELDS = (
+    "lineage_id",
+    "run_id",
+    "step",
+    "config_hash",
+    "fingerprint",
+    "saved_at",
+)
+
+_CKPT_SIDECAR_RE = re.compile(r"^ckpt_(\d+)\.json$")
+
+_fingerprint_cache: Optional[str] = None
+
+
+def new_id() -> str:
+    """16 lowercase hex chars — run ids and lineage ids."""
+    return uuid.uuid4().hex[:16]
+
+
+def config_hash(config_json: str) -> str:
+    """sha256[:16] of a config's JSON text — two runs with the same hash
+    trained under the same experiment configuration."""
+    return hashlib.sha256(config_json.encode()).hexdigest()[:16]
+
+
+def code_fingerprint() -> str:
+    """sha256[:16] over the package's own ``*.py`` tree (sorted relpath +
+    content) — a git-sha equivalent that works in deployments without a
+    ``.git`` directory.  Computed once per process (the tree does not
+    change under a running trainer)."""
+    global _fingerprint_cache
+    if _fingerprint_cache is not None:
+        return _fingerprint_cache
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        dirnames.sort()
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError:
+                continue  # racing editor/packaging — fingerprint best-effort
+            h.update(rel.encode())
+            h.update(b"\x00")
+            h.update(data)
+            h.update(b"\x00")
+    _fingerprint_cache = h.hexdigest()[:16]
+    return _fingerprint_cache
+
+
+def make_lineage(
+    step: int,
+    run_id: Optional[str] = None,
+    config_hash_hex: Optional[str] = None,
+) -> dict:
+    """A fresh lineage record for a checkpoint about to be saved.
+
+    ``saved_at`` is stamped here and re-stamped by ``save_snapshot`` at
+    the durable-write moment — the freshness gauges anchor on the value
+    the checkpoint actually carries."""
+    return {
+        "lineage_id": new_id(),
+        "run_id": run_id or LINEAGE_UNKNOWN,
+        "step": int(step),
+        "config_hash": config_hash_hex or LINEAGE_UNKNOWN,
+        "fingerprint": code_fingerprint(),
+        "saved_at": time.time(),
+    }
+
+
+def unknown_lineage(step: Optional[int] = None) -> dict:
+    """The degradation record for pre-lineage checkpoints: every identity
+    field is the explicit ``lineage_unknown`` marker, ``saved_at`` is None
+    (no fake timestamps — age gauges SKIP, not lie).  ``step`` is kept
+    when the caller knows it (the filename encodes it even for v1)."""
+    return {
+        "lineage_id": LINEAGE_UNKNOWN,
+        "run_id": LINEAGE_UNKNOWN,
+        "step": int(step) if step is not None else None,
+        "config_hash": LINEAGE_UNKNOWN,
+        "fingerprint": LINEAGE_UNKNOWN,
+        "saved_at": None,
+    }
+
+
+def is_unknown(lineage: Optional[dict]) -> bool:
+    """True when ``lineage`` is absent or the degradation marker."""
+    return (
+        not isinstance(lineage, dict)
+        or lineage.get("lineage_id") in (None, LINEAGE_UNKNOWN)
+    )
+
+
+def flatten(lineage: Optional[dict], prefix: str = "lineage_") -> dict:
+    """Flat-schema projection of a lineage record for JSONL emitters and
+    healthz payloads: ``{lineage_id, lineage_run_id, ...}`` — scalars
+    only, per the obs/schema.py stream contract.  ``lineage_id`` keeps
+    its natural name (no ``lineage_lineage_id``)."""
+    src = lineage if isinstance(lineage, dict) else unknown_lineage()
+    out = {}
+    for field in LINEAGE_FIELDS:
+        key = field if field == "lineage_id" else prefix + field
+        out[key] = src.get(field)
+    return out
+
+
+def newest_checkpoint_lineage(workdir: str) -> Optional[dict]:
+    """Lineage of the newest checkpoint under ``workdir/checkpoints``,
+    read from the JSON sidecar — stdlib-only, so the jax-free router tier
+    can compute model-age against the newest DURABLE checkpoint without
+    importing the checkpoint reader.  Returns None when there are no
+    checkpoints; returns :func:`unknown_lineage` (with the step) when the
+    newest sidecar predates lineage or is unreadable."""
+    ckpt_dir = os.path.join(workdir, "checkpoints")
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return None
+    steps = sorted(
+        int(m.group(1))
+        for m in (_CKPT_SIDECAR_RE.match(n) for n in names)
+        if m
+    )
+    if not steps:
+        return None
+    step = steps[-1]
+    try:
+        with open(os.path.join(ckpt_dir, f"ckpt_{step}.json")) as f:
+            meta = json.load(f)
+    except (OSError, ValueError):
+        return unknown_lineage(step)
+    lin = meta.get("lineage")
+    if not isinstance(lin, dict):
+        return unknown_lineage(step)
+    return dict(lin, step=lin.get("step", step))

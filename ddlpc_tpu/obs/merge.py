@@ -38,6 +38,10 @@ from ddlpc_tpu.utils.fsio import atomic_write_json
 ROUTE_SPAN = "route_request"
 ATTEMPT_SPAN = "router_attempt"
 SERVE_SPAN = "serve_request"
+# A request answered from the router's response cache: no attempt, no
+# replica — the span IS the whole story (ISSUE 17: previously these
+# traces dangled with no fleet-side record at all).
+CACHE_SPAN = "cache_hit"
 
 
 def read_spans(paths: Sequence[str]) -> List[dict]:
@@ -58,6 +62,32 @@ def read_spans(paths: Sequence[str]) -> List[dict]:
                 except ValueError:
                     continue
                 if isinstance(rec, dict) and rec.get("kind") == "span":
+                    rec["_src"] = os.path.basename(path)
+                    out.append(rec)
+    out.sort(key=lambda r: r.get("time", 0.0))
+    return out
+
+
+def read_records(paths: Sequence[str]) -> List[dict]:
+    """EVERY record from the given JSONL files — spans, metrics, fleet
+    events, lineage, autoscale — annotated with ``_src`` and merged into
+    one wall-clock order.  The lineage timeline needs the non-span
+    streams too (``checkpoint_saved`` and ``fleet_serving`` are flat
+    ``kind="lineage"`` records, reloads are ``kind="serve_reload"``), so
+    this is :func:`read_spans` without the kind filter."""
+    out: List[dict] = []
+    for path in paths:
+        try:
+            fh = open(path, "r", encoding="utf-8")
+        except OSError:
+            continue
+        with fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
                     rec["_src"] = os.path.basename(path)
                     out.append(rec)
     out.sort(key=lambda r: r.get("time", 0.0))
@@ -99,16 +129,23 @@ def filter_trace(records: Iterable[dict], trace_id: str) -> List[dict]:
 
 def trace_ids(records: Iterable[dict]) -> List[str]:
     """Request trace ids in first-seen order, roots (``route_request`` /
-    ``serve_request``) first so callers can iterate real requests rather
-    than every process's run id."""
+    ``serve_request`` / ``cache_hit``) first so callers can iterate real
+    requests rather than every process's run id."""
     seen: List[str] = []
     for r in records:
-        if r.get("name") not in (ROUTE_SPAN, SERVE_SPAN):
+        if r.get("name") not in (ROUTE_SPAN, SERVE_SPAN, CACHE_SPAN):
             continue
         t = r.get("trace_id")
         if isinstance(t, str) and t not in seen:
             seen.append(t)
     return seen
+
+
+def filter_lineage(records: Iterable[dict], lineage_id: str) -> List[dict]:
+    """Every record attributed to one checkpoint save — the trainer's
+    ``checkpoint_saved`` event, serve-side reloads, the fleet's
+    ``fleet_serving`` event, and any span stamped with the id."""
+    return [r for r in records if r.get("lineage_id") == lineage_id]
 
 
 def _process_key(rec: dict) -> Tuple[str, object]:
@@ -247,6 +284,26 @@ def attribution(records: Sequence[dict], trace_id: str) -> Dict[str, object]:
     route = next(
         (r for r in recs if r.get("name") == ROUTE_SPAN), None
     )
+    if route is None:
+        # Answered from the response cache: the cache_hit span is the
+        # whole request — same attributable identity (model step +
+        # lineage id), zero replica phases.
+        hit = next((r for r in recs if r.get("name") == CACHE_SPAN), None)
+        if hit is not None:
+            return {
+                "kind": "fleet_trace",
+                "trace_id": trace_id,
+                "cache_hit": True,
+                "total_s": round(float(hit.get("dur_s", 0.0)), 6),
+                "status": hit.get("status"),
+                "model_step": hit.get("model_step"),
+                "lineage_id": hit.get("lineage_id"),
+                "attempts": 0,
+                "retries": 0,
+                "hedges": 0,
+                "processes": len({_process_key(r)[1] for r in recs}),
+                "spans": len(recs),
+            }
     attempts = sorted(
         (r for r in recs if r.get("name") == ATTEMPT_SPAN),
         key=lambda r: r.get("time", 0.0),
@@ -265,9 +322,14 @@ def attribution(records: Sequence[dict], trace_id: str) -> Dict[str, object]:
         "processes": len({_process_key(r)[1] for r in recs}),
         "spans": len(recs),
     }
+    out["cache_hit"] = False
     if route is not None:
         out["total_s"] = round(float(route.get("dur_s", 0.0)), 6)
         out["status"] = route.get("status")
+        if route.get("model_step") is not None:
+            out["model_step"] = route.get("model_step")
+        if route.get("lineage_id") is not None:
+            out["lineage_id"] = route.get("lineage_id")
         if attempts:
             out["router_wait_s"] = round(
                 max(attempts[0].get("time", 0.0) - route.get("time", 0.0),
@@ -315,10 +377,63 @@ def summarize_requests(records: Sequence[dict]) -> List[Dict[str, object]]:
     routed = {
         r.get("trace_id")
         for r in records
-        if r.get("name") == ROUTE_SPAN and isinstance(r.get("trace_id"), str)
+        if r.get("name") in (ROUTE_SPAN, CACHE_SPAN)
+        and isinstance(r.get("trace_id"), str)
     }
     return [
         attribution(records, t)
         for t in trace_ids(records)
         if t in routed
     ]
+
+
+# ---------------------------------------------------------------------------
+# lineage timeline (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def lineage_timeline(
+    records: Sequence[dict], lineage_id: str
+) -> Dict[str, object]:
+    """One checkpoint's life on the merged timeline: trainer save →
+    per-replica reloads → whole-fleet serving → the requests it
+    answered.  Works over :func:`read_records` output (mixed streams);
+    derives ``deploy_latency_s`` = fleet_serving time − ``saved_at``
+    when both ends are present."""
+    recs = filter_lineage(records, lineage_id)
+    events: List[dict] = []
+    saved_at: Optional[float] = None
+    fleet_at: Optional[float] = None
+    served = 0
+    for r in recs:
+        kind, name = r.get("kind"), r.get("name")
+        event = r.get("event")
+        if kind == "lineage" and event == "checkpoint_saved":
+            sv = r.get("lineage_saved_at")
+            if isinstance(sv, (int, float)) and not isinstance(sv, bool):
+                saved_at = float(sv)
+        if kind == "lineage" and event == "fleet_serving":
+            fleet_at = float(r.get("time", 0.0)) or fleet_at
+        if kind == "span" and name in (
+            ROUTE_SPAN, SERVE_SPAN, CACHE_SPAN
+        ):
+            served += 1
+        events.append(
+            {
+                "time": r.get("time"),
+                "kind": kind,
+                "event": event or name,
+                "src": r.get("_src"),
+                "step": r.get("step", r.get("lineage_step")),
+            }
+        )
+    out: Dict[str, object] = {
+        "lineage_id": lineage_id,
+        "events": events,
+        "records": len(recs),
+        "requests_served": served,
+        "saved_at": saved_at,
+        "fleet_serving_at": fleet_at,
+    }
+    if saved_at is not None and fleet_at is not None:
+        out["deploy_latency_s"] = round(max(fleet_at - saved_at, 0.0), 6)
+    return out

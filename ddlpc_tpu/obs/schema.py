@@ -44,6 +44,8 @@ KNOWN_KINDS = frozenset(
         "fleet_trace",  # per-request cross-process attribution (obs/merge.py, scripts/fleet_report.py)
         "autoscale",  # elastic-fleet policy decisions — router.jsonl (serve/autoscale.py)
         "cache",  # response-cache stats snapshots — router.jsonl (serve/cache.py)
+        "lineage",  # checkpoint provenance events — metrics.jsonl/router.jsonl (obs/lineage.py consumers)
+        "prod_soak",  # train-to-serve soak audit records (scripts/prod_soak.py)
     }
 )
 

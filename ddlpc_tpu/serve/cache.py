@@ -38,18 +38,29 @@ from ddlpc_tpu.analysis import lockcheck
 Response = Tuple[int, str, bytes]
 
 
-def response_key(body: bytes, step: int, quant_mode: str) -> str:
+def response_key(
+    body: bytes,
+    step: int,
+    quant_mode: str,
+    lineage_id: Optional[str] = None,
+) -> str:
     """Content address of a predict response.
 
     sha256 over the raw request bytes plus the serving identity
-    (checkpoint step + quantization mode).  Any of the three changing
-    yields a different key, so mixed-step fleets mid-reload can simply
-    decline to cache rather than risk cross-step answers.
+    (checkpoint step + quantization mode + lineage id when the fleet
+    reports one).  Any component changing yields a different key, so
+    mixed-step fleets mid-reload can simply decline to cache rather than
+    risk cross-step answers — and two RUNS that happen to share a step
+    number never share cache entries (the lineage id is per-save).
+    ``lineage_id=None`` reproduces the pre-lineage key, so caches warm
+    under old checkpoints stay valid across an upgrade.
     """
     h = hashlib.sha256()
     h.update(body)
     h.update(b"\x00step=%d" % int(step))
     h.update(b"\x00quant=" + quant_mode.encode("utf-8", "replace"))
+    if lineage_id is not None:
+        h.update(b"\x00lineage=" + lineage_id.encode("utf-8", "replace"))
     return h.hexdigest()
 
 

@@ -198,6 +198,13 @@ class InferenceEngine:
         self.max_bucket = max(1, int(max_bucket))
         self.version = 0
         self.checkpoint_step: Optional[int] = None
+        # Lineage of the serving checkpoint (ISSUE 17): set by from_workdir
+        # and swapped atomically with (state, qstate) on reload, so healthz
+        # and the X-DDLPC-Model-Step header always describe the weights
+        # actually answering.  None until a restore supplies one (pre-
+        # lineage checkpoints arrive as the explicit unknown marker, never
+        # absent — train/checkpoint.py's degradation contract).
+        self.lineage: Optional[dict] = None
         self.last_restore_s: Optional[float] = None
         self._lock = threading.Lock()
         self._state = state
@@ -328,6 +335,7 @@ class InferenceEngine:
                   max_bucket=max_bucket, quantize=quantize,
                   quantize_activations=quantize_activations)
         eng.checkpoint_step = meta.get("step")
+        eng.lineage = meta.get("lineage")
         return eng
 
     # ---- state management --------------------------------------------------
@@ -382,10 +390,14 @@ class InferenceEngine:
             except FileNotFoundError:
                 pass  # pruned between restore and stat — timing still valid
         with self._lock:
+            # (state, qstate, lineage) swap as ONE unit: the re-quantized
+            # tree above was computed from THIS state, and provenance must
+            # never describe weights other than the ones serving.
             self._state = state
             self._qstate = qstate
             self.version += 1
             self.checkpoint_step = meta.get("step")
+            self.lineage = meta.get("lineage")
             self.last_restore_s = restore_s
         self._publish_hbm()
         meta = dict(meta, restore_seconds=round(restore_s, 4))

@@ -49,6 +49,7 @@ from typing import Callable, Dict, List, Optional
 from urllib.parse import urlparse
 
 from ddlpc_tpu.config import FleetConfig
+from ddlpc_tpu.obs import lineage as obs_lineage
 from ddlpc_tpu.obs.aggregate import TelemetryAggregator
 from ddlpc_tpu.obs.http import PROMETHEUS_CTYPE, render_metrics, wants_prometheus
 from ddlpc_tpu.obs.registry import MetricsRegistry
@@ -127,6 +128,15 @@ class ReplicaSupervisor:
             "ddlpc_fleet_restarts_total",
             "Replica relaunches, by replica and classified exit cause.",
             labelnames=("replica", "cause"),
+        )
+        # Deploy latency: checkpoint durable on disk (lineage saved_at,
+        # stamped at the durable-write moment) → 100% of the fleet
+        # serving that step.  Set once per completed rolling reload;
+        # stays at the last value between reloads.
+        self._deploy_latency = registry.gauge(
+            "ddlpc_deploy_latency_s",
+            "Seconds from checkpoint durable-write to the whole fleet "
+            "serving it, per completed rolling reload.",
         )
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -488,6 +498,7 @@ class ReplicaSupervisor:
         updated: List[_ManagedReplica] = []
         details = []
         new_step = None
+        new_lineage: dict = {}
         for rp in live:
             self.router.drain(rp.name, self.cfg.drain_timeout_s)
             try:
@@ -545,6 +556,8 @@ class ReplicaSupervisor:
                     "replicas": details,
                 }
             new_step = meta.get("step")
+            if isinstance(meta.get("lineage"), dict):
+                new_lineage = meta["lineage"]
             # Warmup confirm: the replica answers /healthz with the new
             # step before it re-enters dispatch.
             confirm_deadline = time.monotonic() + self.cfg.scrape_timeout_s + 10
@@ -568,14 +581,51 @@ class ReplicaSupervisor:
         if new_step != old_step:
             self.router.invalidate_cache("rolling_reload")
         self.router.metrics.record_reload(ok=True)
+        # Deploy latency: the last replica just confirmed the new step,
+        # so the WHOLE fleet serves it now; anchor on the checkpoint's
+        # durable-write stamp.  Pre-lineage checkpoints (v1/v2) have no
+        # stamp — report the explicit unknown marker, never a fake zero.
+        lineage_id = new_lineage.get("lineage_id")
+        saved_at = new_lineage.get("saved_at")
+        deploy_latency_s = None
+        if isinstance(saved_at, (int, float)) and not isinstance(
+            saved_at, bool
+        ):
+            deploy_latency_s = max(0.0, time.time() - float(saved_at))
+            self._deploy_latency.set(deploy_latency_s)
         self._log(
             "rolling_reload_done", step=new_step, old_step=old_step,
             replicas=len(updated),
+            lineage_id=lineage_id or obs_lineage.LINEAGE_UNKNOWN,
+            deploy_latency_s=deploy_latency_s,
         )
+        if self.logger is not None:
+            # The fleet-side lineage event: joined with the trainer's
+            # checkpoint_saved record (same lineage_id) by obs/merge.py
+            # to place train→serve hand-off on one timeline.
+            try:
+                self.logger.log(
+                    {
+                        "kind": "lineage",
+                        "event": "fleet_serving",
+                        **obs_lineage.flatten(
+                            new_lineage or obs_lineage.unknown_lineage(
+                                new_step
+                            )
+                        ),
+                        "deploy_latency_s": deploy_latency_s,
+                        "replicas": len(updated),
+                    },
+                    echo=False,
+                )
+            except Exception:
+                pass
         return {
             "ok": True,
             "step": new_step,
             "old_step": old_step,
+            "lineage_id": lineage_id,
+            "deploy_latency_s": deploy_latency_s,
             "replicas": details,
         }
 
@@ -620,10 +670,14 @@ class _FleetHandler(BaseHTTPRequestHandler):
     def aggregator(self) -> Optional[TelemetryAggregator]:
         return getattr(self.server, "aggregator", None)
 
-    def _send(self, status: int, ctype: str, body: bytes) -> None:
+    def _send(
+        self, status: int, ctype: str, body: bytes, extra=()
+    ) -> None:
         self.send_response(status)
         self.send_header("Content-Type", ctype or "application/octet-stream")
         self.send_header("Content-Length", str(len(body)))
+        for name, value in extra:
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
 
@@ -676,13 +730,26 @@ class _FleetHandler(BaseHTTPRequestHandler):
                 # An external client's traceparent continues through the
                 # fleet (its trace id spans client→router→replica);
                 # otherwise a traced router mints a fresh one.
+                info: dict = {}
                 status, ctype, payload = self.router.dispatch(
                     body, parsed.query,
                     trace_context=parse_traceparent(
                         self.headers.get("traceparent")
                     ),
+                    info=info,
                 )
-                self._send(status, ctype, payload)
+                # Every served prediction — cache hits included — names
+                # the checkpoint step it came from.
+                step = info.get("model_step")
+                self._send(
+                    status, ctype, payload,
+                    extra=[(
+                        obs_lineage.MODEL_STEP_HEADER,
+                        str(step)
+                        if step is not None
+                        else obs_lineage.LINEAGE_UNKNOWN,
+                    )],
+                )
             elif parsed.path == "/reload":
                 if self.supervisor is None:
                     self._send_json(

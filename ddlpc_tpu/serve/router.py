@@ -51,6 +51,7 @@ from urllib.parse import parse_qs
 
 from ddlpc_tpu.analysis import lockcheck
 from ddlpc_tpu.config import FleetConfig
+from ddlpc_tpu.obs import lineage as obs_lineage
 from ddlpc_tpu.obs.health import HealthMonitor, SLOTracker
 from ddlpc_tpu.obs.registry import MetricsRegistry
 from ddlpc_tpu.obs.tracing import (
@@ -61,7 +62,10 @@ from ddlpc_tpu.obs.tracing import (
 )
 from ddlpc_tpu.serve.cache import ResponseCache, response_key
 
-Response = Tuple[int, str, bytes]  # (status, content-type, body)
+# (status, content-type, body).  The HTTP client appends a 4th element —
+# the replica's X-DDLPC-Model-Step header — so consumers unpack with
+# ``[:3]``; fakes returning bare 3-tuples stay valid.
+Response = Tuple[int, str, bytes]
 
 
 class ReplicaError(RuntimeError):
@@ -301,7 +305,7 @@ class HTTPReplicaClient(ReplicaClient):
         timeout_s: float,
         headers: Optional[dict] = None,
         cancel: Optional[threading.Event] = None,
-    ) -> Response:
+    ) -> Tuple[int, str, bytes, Optional[str]]:
         conn = http.client.HTTPConnection(
             self.host, self.port, timeout=timeout_s
         )
@@ -313,7 +317,15 @@ class HTTPReplicaClient(ReplicaClient):
             conn.request(method, path, body=body, headers=headers or {})
             resp = conn.getresponse()
             data = resp.read()
-            return resp.status, resp.getheader("Content-Type", ""), data
+            # 4th element: the replica's X-DDLPC-Model-Step provenance
+            # header (None when absent).  Response consumers unpack via
+            # ``[:3]`` so 3-tuple fakes and this 4-tuple interchange.
+            return (
+                resp.status,
+                resp.getheader("Content-Type", ""),
+                data,
+                resp.getheader(obs_lineage.MODEL_STEP_HEADER),
+            )
         except Exception as e:
             raise ReplicaError(f"{self.name}: {type(e).__name__}: {e}") from e
         finally:
@@ -352,13 +364,15 @@ class HTTPReplicaClient(ReplicaClient):
         status, _, body = self._request(
             "GET", "/metrics", None, timeout_s,
             headers={"Accept": "text/plain"},
-        )
+        )[:3]
         if status != 200:
             raise ReplicaError(f"{self.name}: /metrics returned {status}")
         return body.decode("utf-8", errors="replace")
 
     def healthz(self, timeout_s: float) -> dict:
-        status, _, body = self._request("GET", "/healthz", None, timeout_s)
+        status, _, body = self._request(
+            "GET", "/healthz", None, timeout_s
+        )[:3]
         try:
             h = json.loads(body)
         except ValueError:
@@ -371,7 +385,7 @@ class HTTPReplicaClient(ReplicaClient):
         status, _, body = self._request(
             "POST", "/reload", json.dumps(payload).encode(), timeout_s,
             headers={"Content-Type": "application/json"},
-        )
+        )[:3]
         try:
             meta = json.loads(body) if body else {}
         except ValueError:
@@ -490,6 +504,22 @@ class RouterMetrics:
                     "ddlpc_cache_entries",
                     "Entries currently held by the response cache.",
                 ),
+                # Freshness SLOs (ISSUE 17).  Replicas with unknown
+                # lineage are SKIPPED (their healthz shows the explicit
+                # lineage_unknown marker) — an absent series, never a
+                # fabricated age.
+                "model_age": registry.gauge(
+                    "ddlpc_serve_model_age_s",
+                    "Per-replica serving-checkpoint age: newest durable "
+                    "checkpoint's save time minus the serving one's "
+                    "(replica=\"fleet\" is the worst live replica).",
+                    labelnames=("replica",),
+                ),
+                "step_skew": registry.gauge(
+                    "ddlpc_fleet_step_skew",
+                    "max - min over live replicas' serving checkpoint "
+                    "steps; nonzero marks a mixed-weights window.",
+                ),
             }
         # Last cache totals pushed to the registry, so sync_cache can inc
         # the monotonic counters by delta (the cache keeps the totals).
@@ -572,6 +602,14 @@ class RouterMetrics:
         if self._reg is not None:
             self._reg["ready"].set(n)
 
+    def set_model_age(self, replica: str, age_s: float) -> None:
+        if self._reg is not None:
+            self._reg["model_age"].set(float(age_s), replica=replica)
+
+    def set_step_skew(self, skew: float) -> None:
+        if self._reg is not None:
+            self._reg["step_skew"].set(float(skew))
+
     def sync_cache(self, stats: Dict[str, float]) -> None:
         """Push a ResponseCache.stats() snapshot to the registry: gauges
         are set absolutely, counters advance by delta since last sync."""
@@ -652,6 +690,10 @@ class _Replica:
         self.checkpoint_step: Optional[int] = None  # scraped
         self.version: Optional[int] = None  # scraped
         self.slot_busy: Optional[float] = None  # scraped (autoscaler signal)
+        # Serving lineage (scraped): the literal marker string for
+        # pre-lineage checkpoints — visible on /fleet, skipped by gauges.
+        self.lineage_id: Optional[str] = None  # scraped
+        self.lineage_saved_at: Optional[float] = None  # scraped
         self.scrape_fail_streak = 0
         # True once this replica has EVER answered anything (a successful
         # scrape or any HTTP response to an attempt).  Until then a
@@ -676,6 +718,8 @@ class _Replica:
             "checkpoint_step": self.checkpoint_step,
             "version": self.version,
             "slot_busy": self.slot_busy,
+            "lineage_id": self.lineage_id,
+            "lineage_saved_at": self.lineage_saved_at,
         }
 
 
@@ -860,11 +904,64 @@ class FleetRouter:
                 r.version = h.get("version")
                 sb = h.get("slot_busy_fraction")
                 r.slot_busy = float(sb) if sb is not None else None
+                lid = h.get("lineage_id")
+                r.lineage_id = lid if isinstance(lid, str) else None
+                sv = h.get("lineage_saved_at")
+                r.lineage_saved_at = (
+                    float(sv)
+                    if isinstance(sv, (int, float))
+                    and not isinstance(sv, bool)
+                    else None
+                )
                 if h.get("status") == "draining":
                     # The replica is shutting down on its own (SIGTERM):
                     # treat like a router-side drain — no new dispatch.
                     r.draining = True
+        try:
+            self._update_freshness()
+        except Exception:
+            pass  # freshness accounting must never break the scrape
         self._publish_ready()
+
+    def _update_freshness(self) -> None:
+        """Model-age + step-skew gauges from the latest scrape (ISSUE 17).
+
+        Age = newest DURABLE checkpoint's ``saved_at`` (read from the
+        sidecar via the stdlib path — no jax import in this tier) minus
+        the replica's serving ``saved_at``.  Replicas whose lineage is
+        the unknown marker are skipped — their healthz carries the
+        explicit ``lineage_unknown`` string; the gauge never invents an
+        age for them.  The ``replica="fleet"`` series is the worst live
+        replica (the fleet is only as fresh as its stalest member)."""
+        workdir = getattr(self.cfg, "workdir", None)
+        newest = (
+            obs_lineage.newest_checkpoint_lineage(workdir)
+            if workdir
+            else None
+        )
+        newest_saved = newest.get("saved_at") if newest else None
+        with self._lock:
+            live = [
+                r for r in self._replicas.values()
+                if r.ready and r.healthy and not r.draining
+            ]
+            rows = [(r.name, r.lineage_saved_at) for r in live]
+            steps = [
+                int(r.checkpoint_step)
+                for r in live
+                if r.checkpoint_step is not None
+            ]
+        ages = []
+        for name, saved in rows:
+            if newest_saved is None or saved is None:
+                continue
+            age = max(0.0, float(newest_saved) - float(saved))
+            self.metrics.set_model_age(name, age)
+            ages.append(age)
+        if ages:
+            self.metrics.set_model_age("fleet", max(ages))
+        if steps:
+            self.metrics.set_step_skew(float(max(steps) - min(steps)))
 
     def start(self) -> "FleetRouter":
         """Start the background scrape loop (and JSONL emitter if a
@@ -1169,6 +1266,7 @@ class FleetRouter:
     def dispatch(
         self, body: bytes, query: str = "",
         trace_context: Optional[Tuple[str, Optional[str]]] = None,
+        info: Optional[dict] = None,
     ) -> Response:
         """Route one request; ALWAYS returns a response.  A 5xx here means
         every eligible replica (and every retry/hedge) failed — the
@@ -1181,7 +1279,13 @@ class FleetRouter:
         ``trace_context`` is an optional (trace_id, parent span hex) pair
         parsed from an inbound traceparent header — an external client's
         trace continues through the fleet; without one a traced router
-        mints a fresh request trace id."""
+        mints a fresh request trace id.
+
+        ``info``, when given, is filled in-place with attribution for
+        the caller's response headers: ``cache_hit``, ``model_step``
+        (the serving checkpoint step this answer came from), and
+        ``lineage_id`` — every served prediction, including a cache
+        hit, stays attributable to the exact training step."""
         priority = _priority_of(query)
         if priority == "batch" and self._should_shed_batch():
             self.metrics.record_batch_shed()
@@ -1191,11 +1295,15 @@ class FleetRouter:
                 "retry with backoff"
             )
         t0 = time.monotonic()
+        inf = info if info is not None else {}
+        tr = self.tracer
         cache_key = None
         if self.cache.enabled and not _cache_bypass(query):
             ident = self._cache_identity()
             if ident is not None:
-                cache_key = response_key(body, ident[0], ident[1])
+                cache_key = response_key(
+                    body, ident[0], ident[1], lineage_id=ident[2]
+                )
                 cached = self.cache.get(cache_key)
                 if cached is not None:
                     # A hit is a real answered request: it feeds the same
@@ -1204,8 +1312,30 @@ class FleetRouter:
                     latency_s = time.monotonic() - t0
                     self.metrics.record_request(latency_s, True)
                     self.slo.observe(priority, latency_s, True)
+                    inf["cache_hit"] = True
+                    inf["model_step"] = ident[0]
+                    inf["lineage_id"] = ident[2]
+                    if tr is not None and tr.enabled:
+                        # The hit used to return without a span — a
+                        # dangling trace with no fleet-side record.  The
+                        # cache_hit span closes it, carrying the same
+                        # lineage attribution as a routed answer, and is
+                        # breaker-neutral by construction: no replica is
+                        # touched, so no breaker sees this request.
+                        trace_id, parent_hex = (
+                            trace_context
+                            if trace_context is not None
+                            else (new_trace_id(), None)
+                        )
+                        with tr.bind(trace_id, parent_hex):
+                            with tr.span(
+                                "cache_hit",
+                                priority=priority,
+                                model_step=ident[0],
+                                lineage_id=ident[2],
+                            ) as sp:
+                                sp.set(status=cached[0])
                     return cached
-        tr = self.tracer
         if tr is not None and tr.enabled:
             trace_id, parent_hex = (
                 trace_context
@@ -1215,12 +1345,16 @@ class FleetRouter:
             with tr.bind(trace_id, parent_hex):
                 with tr.span("route_request", priority=priority) as sp:
                     status, ctype, payload = self._dispatch_inner(
-                        body, query, priority, trace_id
+                        body, query, priority, trace_id, info=inf
                     )
-                    sp.set(status=status)
+                    sp.set(
+                        status=status,
+                        model_step=inf.get("model_step"),
+                        lineage_id=inf.get("lineage_id"),
+                    )
         else:
             status, ctype, payload = self._dispatch_inner(
-                body, query, priority
+                body, query, priority, info=inf
             )
         ok = status < 500
         latency_s = time.monotonic() - t0
@@ -1232,14 +1366,17 @@ class FleetRouter:
 
     # -- response cache -----------------------------------------------------
 
-    def _cache_identity(self) -> Optional[Tuple[int, str]]:
-        """The fleet's consensus serving identity (step, quant mode), or
-        None when there isn't one — no scraped step yet, or mixed steps /
-        quant modes mid-rolling-reload (caching simply pauses; the step
-        is also in the key, so this is belt on top of braces).  A
-        consensus step DIFFERENT from the last one flushes the cache:
-        that is the fleet-wide invalidation on any reload — forward or
-        rollback — that changes the serving step."""
+    def _cache_identity(self) -> Optional[Tuple[int, str, Optional[str]]]:
+        """The fleet's consensus serving identity (step, quant mode,
+        lineage id), or None when there isn't one — no scraped step yet,
+        or mixed steps / quant modes mid-rolling-reload (caching simply
+        pauses; the step is also in the key, so this is belt on top of
+        braces).  The lineage id is part of the returned identity only
+        when every live replica agrees on one; disagreement or the
+        unknown marker degrades to None (the pre-lineage key), never a
+        refusal to cache.  A consensus step DIFFERENT from the last one
+        flushes the cache: that is the fleet-wide invalidation on any
+        reload — forward or rollback — that changes the serving step."""
         flush = False
         with self._lock:
             live = [
@@ -1252,6 +1389,10 @@ class FleetRouter:
             if len(steps) != 1 or len(quants) != 1:
                 return None
             step, quant = steps.pop(), quants.pop()
+            lids = {r.lineage_id for r in live}
+            lid = lids.pop() if len(lids) == 1 else None
+            if lid == obs_lineage.LINEAGE_UNKNOWN:
+                lid = None
             if self._cache_step is not None and self._cache_step != step:
                 flush = True
             self._cache_step = step
@@ -1263,7 +1404,7 @@ class FleetRouter:
                 "cache_invalidate", reason="step_change", dropped=dropped,
                 step=step,
             )
-        return step, quant
+        return step, quant, lid
 
     def invalidate_cache(self, reason: str) -> int:
         """Fleet-wide cache flush, called by the supervisor around any
@@ -1283,7 +1424,7 @@ class FleetRouter:
 
     def _dispatch_inner(
         self, body: bytes, query: str, priority: str = "interactive",
-        trace_id: Optional[str] = None,
+        trace_id: Optional[str] = None, info: Optional[dict] = None,
     ) -> Response:
         cfg = self.cfg
         done: "queue.Queue[_Attempt]" = queue.Queue()
@@ -1323,7 +1464,7 @@ class FleetRouter:
             pending -= 1
             kind, val = fin.outcome  # type: ignore[misc]
             if kind == "response":
-                st, ctype, payload = val  # type: ignore[misc]
+                st, ctype, payload = val[:3]  # type: ignore[misc]
                 if st < 500:
                     # Success or a client-owned 4xx: either way the replica
                     # answered coherently — return it, cancel the rest
@@ -1331,6 +1472,20 @@ class FleetRouter:
                     self._cancel(attempts, fin)
                     if fin.reason == "hedge":
                         self.metrics.record_hedge_win()
+                    if info is not None:
+                        # Attribution: prefer the replica's per-response
+                        # model-step header (exact even mid-reload) over
+                        # the last scrape's step.
+                        hdr = val[3] if len(val) > 3 else None
+                        info["cache_hit"] = False
+                        info["replica"] = fin.replica.name
+                        if hdr is not None and hdr.isdigit():
+                            info["model_step"] = int(hdr)
+                        elif hdr is not None:
+                            info["model_step"] = hdr
+                        else:
+                            info["model_step"] = fin.replica.checkpoint_step
+                        info["lineage_id"] = fin.replica.lineage_id
                     return st, ctype, payload
                 cause = f"http_{st}"
             else:
@@ -1402,6 +1557,10 @@ class FleetRouter:
             ),
             "replica_status": statuses,
         }
+        steps = out["checkpoint_steps"]
+        # Nonzero only in a mixed-weights window (mid-rolling-reload);
+        # the fleet test pins >0 there and ==0 once converged.
+        out["step_skew"] = (max(steps) - min(steps)) if steps else None
         if self.cache.enabled:
             out["cache"] = self.cache.stats()
         if self.slo.enabled:

@@ -35,6 +35,7 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from ddlpc_tpu.config import ServeConfig
+from ddlpc_tpu.obs import lineage as obs_lineage
 from ddlpc_tpu.obs import profiling as _profiling
 from ddlpc_tpu.obs.health import Alert as HealthAlert
 from ddlpc_tpu.obs.health import HealthMonitor
@@ -353,6 +354,10 @@ class ServingFrontend:
                     "step": meta.get("step"),
                     "restore_seconds": meta.get("restore_seconds"),
                     "restore_format": meta.get("restore_format"),
+                    # Flat lineage join key (the record itself stays flat
+                    # per obs/schema.py) — how obs/merge.py ties this
+                    # reload to the checkpoint save span that produced it.
+                    **obs_lineage.flatten(meta.get("lineage")),
                 },
                 echo=False,
             )
@@ -400,6 +405,10 @@ class ServingFrontend:
             "compiled_shapes": self.engine.compiled_shapes,
             "last_reload_error": self.last_reload_error,
             "alerts": list(self.health.alerts),
+            # Lineage of the serving weights, FLAT (the router scrapes
+            # these fields into its freshness gauges; pre-lineage
+            # checkpoints surface the explicit unknown marker).
+            **obs_lineage.flatten(getattr(self.engine, "lineage", None)),
         }
 
     def debug_trace(self, steps: Optional[int] = None, timeout_s: float = 30.0) -> dict:
@@ -573,11 +582,13 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _send_npy(self, arr: np.ndarray) -> None:
+    def _send_npy(self, arr: np.ndarray, extra=()) -> None:
         body = _dump_npy(arr)
         self.send_response(200)
         self.send_header("Content-Type", "application/x-npy")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in extra:
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -675,7 +686,18 @@ class _Handler(BaseHTTPRequestHandler):
             # lose any pipelined keep-alive request with it)
             self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
         else:
-            self._send_npy(pred)
+            # Provenance header (ISSUE 17): every prediction names the
+            # training step that produced it; pre-lineage checkpoints get
+            # the explicit unknown marker, never a missing header.
+            step = getattr(self.frontend.engine, "checkpoint_step", None)
+            self._send_npy(
+                pred,
+                extra=[(
+                    obs_lineage.MODEL_STEP_HEADER,
+                    str(step) if step is not None
+                    else obs_lineage.LINEAGE_UNKNOWN,
+                )],
+            )
 
     def _reload(self, body: bytes) -> None:
         try:
@@ -706,6 +728,11 @@ class _Handler(BaseHTTPRequestHandler):
             "restore_seconds": meta.get("restore_seconds"),
             "restore_format": meta.get("restore_format"),
         }
+        if isinstance(meta.get("lineage"), dict):
+            # Nested is fine in HTTP JSON (the flat contract binds JSONL
+            # streams only): the fleet's rolling reload reads saved_at
+            # from here to measure checkpoint-durable → fleet-serving.
+            resp["lineage"] = meta["lineage"]
         if meta.get("quantize"):
             # A quantized engine's reload answer says what is now
             # resident (scales were recomputed from the new checkpoint).

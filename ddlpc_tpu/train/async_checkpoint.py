@@ -108,6 +108,13 @@ class AsyncCheckpointer:
         Blocks only for the host snapshot — plus, if the previous write
         is still running, a barrier on it (which also re-raises its
         failure here, on the training thread).
+
+        ``metadata`` (including the trainer's lineage record) passes
+        through to ``save_snapshot`` untouched; lineage ``saved_at`` is
+        restamped THERE, on the writer thread at the durable-write
+        moment — under a backlogged async writer the trainer-side stamp
+        can be arbitrarily stale, and the freshness/deploy-latency
+        gauges must anchor on when bytes actually hit disk.
         """
         t0 = time.perf_counter()
         self.wait()

@@ -53,6 +53,7 @@ import os
 import re
 import struct
 import tempfile
+import time
 import warnings
 import zlib
 from typing import Any, Iterator, List, Optional, Tuple
@@ -61,6 +62,7 @@ import jax
 import numpy as np
 from flax import serialization
 
+from ddlpc_tpu.obs import lineage as _lineage
 from ddlpc_tpu.resilience.chaos import active as _chaos_active
 from ddlpc_tpu.utils import wire
 
@@ -208,7 +210,11 @@ def _leaf_chunks(arr: np.ndarray, chunk_bytes: int) -> List[memoryview]:
 
 
 def _write_chunked(
-    f, snap: dict, chunk_bytes: int, compression: str
+    f,
+    snap: dict,
+    chunk_bytes: int,
+    compression: str,
+    lineage: Optional[dict] = None,
 ) -> None:
     """Stream the snapshot through the wire codec into open file ``f``."""
     if compression not in ("adaptive", "always", "store"):
@@ -264,7 +270,13 @@ def _write_chunked(
                 [offset, len(frame), raw_len, zlib.crc32(frame)]
             )
             offset += len(frame)
-    manifest = json.dumps({"version": 2, "leaves": leaves}).encode()
+    # Manifest v3 = v2 + the lineage record (ISSUE 17): provenance travels
+    # INSIDE the blob, surviving sidecar loss.  v1/v2 readers that ignore
+    # unknown manifest keys restore v3 blobs unchanged.
+    doc: dict = {"version": 3, "leaves": leaves}
+    if lineage is not None:
+        doc["lineage"] = lineage
+    manifest = json.dumps(doc).encode()
     f.write(manifest)
     f.write(
         _DWC2_FOOTER.pack(offset, len(manifest), zlib.crc32(manifest), b"DWC2")
@@ -552,7 +564,16 @@ def save_snapshot(
         raise ValueError(f"unknown checkpoint format {format!r}")
     os.makedirs(ckpt_dir, exist_ok=True)
     name = f"ckpt_{step}.dwc" if format == "chunked" else f"ckpt_{step}.msgpack.z"
-    meta = dict(metadata or {}, step=step)
+    # Lineage (ISSUE 17): every save carries a provenance record.  The
+    # trainer supplies one (run id + config hash); bare callers get a
+    # synthesized record so downstream NEVER sees an absent lineage on a
+    # fresh save.  saved_at is (re)stamped HERE — the durable-write
+    # moment is what the freshness/deploy-latency gauges anchor on.
+    lin = (metadata or {}).get("lineage")
+    if not isinstance(lin, dict):
+        lin = _lineage.make_lineage(step)
+    lin = dict(lin, step=int(step), saved_at=time.time())
+    meta = dict(metadata or {}, step=step, lineage=lin)
     meta_tmp = os.path.join(ckpt_dir, f".meta_{step}.tmp")
     try:
         with open(meta_tmp, "w") as f:
@@ -575,7 +596,7 @@ def save_snapshot(
     try:
         with os.fdopen(fd, "wb") as f:
             if format == "chunked":
-                _write_chunked(f, snap, chunk_bytes, compression)
+                _write_chunked(f, snap, chunk_bytes, compression, lineage=lin)
             else:
                 f.write(
                     wire.compress(serialization.msgpack_serialize(_unflatten(snap)))
@@ -695,6 +716,42 @@ def peek_metadata(ckpt_dir: str, step: Optional[int] = None) -> dict:
         return json.load(f)
 
 
+def read_manifest_lineage(path: str) -> Optional[dict]:
+    """The lineage record embedded in a ``.dwc`` blob's manifest, or None
+    (pre-v3 blob, no lineage key, or any read/parse failure — lineage
+    recovery must never turn a restorable blob into an error).  Reads only
+    the file tail, like :func:`_footer_ok`."""
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            head = f.read(len(_DWC_MAGIC))
+            if head != _DWC_MAGIC:
+                return None
+            f.seek(max(0, size - _DWC2_FOOTER.size))
+            foot = f.read()
+            if foot.endswith(b"DWC2"):
+                man_off, man_len, man_crc, _ = _DWC2_FOOTER.unpack(
+                    foot[-_DWC2_FOOTER.size :]
+                )
+            elif foot.endswith(b"DWCK"):
+                man_off, man_len = _DWC_FOOTER.unpack(
+                    foot[-_DWC_FOOTER.size :]
+                )[:2]
+                man_crc = None
+            else:
+                return None
+            if man_off + man_len > size:
+                return None
+            f.seek(man_off)
+            man_bytes = f.read(man_len)
+        if man_crc is not None and zlib.crc32(man_bytes) != man_crc:
+            return None
+        lin = json.loads(man_bytes).get("lineage")
+        return lin if isinstance(lin, dict) else None
+    except (OSError, *CorruptionError):
+        return None
+
+
 def _restore_step(ckpt_dir: str, target: PyTree, step: int) -> Tuple[PyTree, dict]:
     path, fmt = checkpoint_path(ckpt_dir, step)
     if fmt == "chunked":
@@ -707,6 +764,14 @@ def _restore_step(ckpt_dir: str, target: PyTree, step: int) -> Tuple[PyTree, dic
     if os.path.exists(meta_path):
         with open(meta_path) as f:
             meta = json.load(f)
+    # Lineage degradation contract (ISSUE 17): EVERY restore's metadata
+    # carries a lineage dict.  Sidecar first (both formats write it),
+    # then the v3 manifest (survives sidecar loss), then the explicit
+    # unknown marker — pre-lineage checkpoints restore and serve, with
+    # downstream gauges degrading instead of crashing.
+    if not isinstance(meta.get("lineage"), dict):
+        lin = read_manifest_lineage(path) if fmt == "chunked" else None
+        meta = dict(meta, lineage=lin or _lineage.unknown_lineage(step))
     return state, meta
 
 

@@ -13,6 +13,7 @@ metric), checkpoint/resume, and a config artifact per run.
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import threading
@@ -45,6 +46,7 @@ from ddlpc_tpu.parallel.train_step import (
 from ddlpc_tpu.obs import comm as obs_comm
 from ddlpc_tpu.obs import flops as obs_flops
 from ddlpc_tpu.obs import hbm as obs_hbm
+from ddlpc_tpu.obs import lineage as obs_lineage
 from ddlpc_tpu.obs.health import HealthMonitor
 from ddlpc_tpu.obs.http import TelemetryServer
 from ddlpc_tpu.obs.profiling import OnDemandProfiler
@@ -167,6 +169,13 @@ class Trainer:
         # near-free no-op — so every instrumentation site below stays
         # unconditional too.
         self.registry = MetricsRegistry()
+        # Model lineage (ISSUE 17): one run id per Trainer construction +
+        # the config hash every checkpoint this run saves will carry — the
+        # identity the serving fleet resolves responses back to.
+        self.run_id = obs_lineage.new_id()
+        self.config_hash = obs_lineage.config_hash(
+            json.dumps(cfg.to_dict(), sort_keys=True)
+        )
         self.tracer = Tracer(
             enabled=cfg.train.trace and jax.process_index() == 0,
             service="train",
@@ -359,6 +368,9 @@ class Trainer:
         # process-lifetime, matching the schedule's step semantics.
         self._chaos = _chaos_mod.active()
         self._chaos_step = 0
+        # Lineage of the checkpoint this run resumed from (None on a cold
+        # start; the explicit unknown marker on pre-lineage checkpoints).
+        self.restored_lineage: Optional[dict] = None
         if resume:
             self._restore_synchronized()
         self.logger = MetricsLogger(
@@ -498,6 +510,7 @@ class Trainer:
                 state, meta = ckpt.restore_checkpoint(self.ckpt_dir, self.state)
                 self.state = self.layout.place(state)
                 self.start_epoch = int(meta.get("epoch", -1)) + 1
+                self.restored_lineage = meta.get("lineage")
                 self._apply_mid_epoch(int(meta.get("mid_epoch_steps_done", 0)))
             return
         from jax.experimental import multihost_utils
@@ -506,6 +519,7 @@ class Trainer:
             state, meta = ckpt.restore_checkpoint(self.ckpt_dir, self.state)
             found, epoch_next = 1, int(meta.get("epoch", -1)) + 1
             skip = int(meta.get("mid_epoch_steps_done", 0))
+            self.restored_lineage = meta.get("lineage")
         else:
             state, found, epoch_next, skip = None, 0, 0, 0
         # Separate found flag: a checkpoint with missing/epoch-less metadata
@@ -614,6 +628,10 @@ class Trainer:
         with self.watchdog.paused("preempt_checkpoint"):
             state = self.layout.canonical(self.state)
             step = int(jax.device_get(self.state.step))
+            lin = obs_lineage.make_lineage(
+                step, run_id=self.run_id, config_hash_hex=self.config_hash
+            )
+            meta["lineage"] = lin
             self.checkpointer.save(self.ckpt_dir, state, step=step, metadata=meta)
             # The emergency checkpoint must be DURABLE before the process
             # exits — this is the one save that cannot overlap anything.
@@ -626,6 +644,9 @@ class Trainer:
                 "ckpt_step": step,
             },
             echo=True,
+        )
+        self._log_lineage(
+            "checkpoint_saved", lin, epoch=epoch, preempted=True
         )
         if jax.process_index() == 0:
             write_breadcrumb(
@@ -864,6 +885,21 @@ class Trainer:
             max_samples=n,
         )
 
+    def _log_lineage(self, event: str, lin: dict, **fields) -> None:
+        """Append a flat ``kind="lineage"`` record to metrics.jsonl — the
+        train-side anchor obs/merge.py joins serve-side streams onto."""
+        if jax.process_index() != 0:
+            return
+        self.logger.log(
+            {
+                "kind": "lineage",
+                "event": event,
+                **obs_lineage.flatten(lin),
+                **fields,
+            },
+            echo=False,
+        )
+
     def save(self, epoch: int) -> None:
         # Checkpoints store the canonical gathered layout — under a sharded
         # run layout this all-gathers the moments ONCE per save (a
@@ -871,20 +907,31 @@ class Trainer:
         # on-disk blob restores bit-identically into either layout.  The
         # gather is a collective: every process runs it, then only process
         # 0 snapshots/writes (AsyncCheckpointer's gate).
-        with self.tracer.span("checkpoint_snapshot", epoch=epoch):
+        step = int(jax.device_get(self.state.step))
+        lin = obs_lineage.make_lineage(
+            step, run_id=self.run_id, config_hash_hex=self.config_hash
+        )
+        with self.tracer.span(
+            "checkpoint_snapshot",
+            epoch=epoch,
+            lineage_id=lin["lineage_id"],
+            step=step,
+        ):
             state = self.layout.canonical(self.state)
             self.checkpointer.save(
                 self.ckpt_dir,
                 state,
-                step=int(jax.device_get(self.state.step)),
+                step=step,
                 metadata={
                     "epoch": epoch,
                     "config": self.cfg.to_dict(),
                     # The predict CLI rebuilds its restore target from this —
                     # channels come from the dataset, not the config (ADVICE r1).
                     "input_channels": int(self.train_ds.image_shape[-1]),
+                    "lineage": lin,
                 },
             )
+        self._log_lineage("checkpoint_saved", lin, epoch=epoch)
         if jax.process_index() == 0:
             # Progress breadcrumb: the supervisor resets its crash-loop
             # counter when this step advances between attempts.

@@ -19,14 +19,22 @@ Usage:
     python scripts/obs_tail.py runs/x --where name=jit_execute
     python scripts/obs_tail.py runs/x --keys loss,step_time_s # trim columns
     python scripts/obs_tail.py runs/x -n 50                   # last 50/file
+    python scripts/obs_tail.py fleet -n 0 --trace <32-hex>    # one request
+    python scripts/obs_tail.py runs/x fleet -n 0 --lineage <16-hex>
 
 Filters:
-    --kind  comma list matched against the record's ``kind`` field
-            (records without one count as kind "train");
-    --where key=value pairs, all must match (string compare on the
-            record's value — ``--where severity=critical``);
-    --keys  comma list of keys to print (plus kind/time), unmatched keys
-            dropped; default prints the whole record.
+    --kind    comma list matched against the record's ``kind`` field
+              (records without one count as kind "train");
+    --where   key=value pairs, all must match (string compare on the
+              record's value — ``--where severity=critical``);
+    --trace   one request's story: records whose ``trace_id`` matches, or
+              whose ``trace_ids`` batch list contains the id (a batcher
+              span serves several requests at once);
+    --lineage one checkpoint's story: records whose ``lineage_id``
+              matches — trainer ``checkpoint_saved``, serve reloads,
+              ``fleet_serving``, and request spans stamped with the id;
+    --keys    comma list of keys to print (plus kind/time), unmatched
+              keys dropped; default prints the whole record.
 
 Output is the raw (possibly trimmed) JSON object per line — pipe into jq
 for anything fancier.
@@ -63,8 +71,22 @@ def _note_stale(rec: dict, src: str, noted: set) -> None:
         )
 
 
-def _match(rec: dict, kinds: Optional[set], where: Dict[str, str]) -> bool:
+def _match(
+    rec: dict,
+    kinds: Optional[set],
+    where: Dict[str, str],
+    trace: Optional[str] = None,
+    lineage: Optional[str] = None,
+) -> bool:
     if kinds is not None and str(rec.get("kind", "train")) not in kinds:
+        return False
+    if trace is not None:
+        tids = rec.get("trace_ids")
+        if rec.get("trace_id") != trace and not (
+            isinstance(tids, list) and trace in tids
+        ):
+            return False
+    if lineage is not None and rec.get("lineage_id") != lineage:
         return False
     for k, v in where.items():
         if str(rec.get(k)) != v:
@@ -103,6 +125,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--where", action="append", default=[],
                     metavar="KEY=VALUE", help="field equality filter (repeatable)")
     ap.add_argument("--keys", default=None, help="comma list of keys to keep")
+    ap.add_argument("--trace", default=None, metavar="ID",
+                    help="only records belonging to this request trace id")
+    ap.add_argument("--lineage", default=None, metavar="ID",
+                    help="only records stamped with this lineage id")
     args = ap.parse_args(argv)
 
     kinds = set(args.kind.split(",")) if args.kind else None
@@ -155,7 +181,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             except json.JSONDecodeError:
                 continue
             _note_stale(rec, src, stale_noted)
-            if _match(rec, kinds, where):
+            if _match(rec, kinds, where, args.trace, args.lineage):
                 initial.append((sort_key(path, rec), src, rec))
         handles[path] = fh
     initial.sort(key=lambda item: item[0])
@@ -191,7 +217,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                         continue
                     src = os.path.basename(path)
                     _note_stale(rec, src, stale_noted)
-                    if _match(rec, kinds, where):
+                    if _match(rec, kinds, where, args.trace, args.lineage):
                         batch.append((sort_key(path, rec), src, rec))
             if batch:
                 batch.sort(key=lambda item: item[0])

@@ -15,6 +15,7 @@ Pins the four load-bearing guarantees:
   compressible ones (the save-throughput claim's mechanism).
 """
 
+import json
 import os
 
 import numpy as np
@@ -193,7 +194,7 @@ def _crashing_save(monkeypatch, d, state, step, stage):
     if stage == 2:
         real_write = ckpt._write_chunked
 
-        def partial_write(f, snap, chunk_bytes, compression):
+        def partial_write(f, snap, chunk_bytes, compression, lineage=None):
             f.write(ckpt._DWC_MAGIC + b"\x01" * 100)  # torn mid-stream
             raise _Boom("mid-blob write")
 
@@ -270,10 +271,28 @@ def test_async_save_equals_sync_save(tmp_path):
     a, _ = ckpt.restore_checkpoint(d_sync, target_like(state))
     b, _ = ckpt.restore_checkpoint(d_async, target_like(state))
     assert_states_equal(a, b)
-    # Byte-level: same snapshot → same manifest + chunk stream.
+    # Byte-level: same snapshot → same chunk stream and same manifest —
+    # modulo the per-save lineage stamp (unique id + durable-write time
+    # by design), the one field a second save of identical bytes must
+    # legitimately differ in.
     pa = ckpt.checkpoint_path(d_sync, 1)[0]
     pb = ckpt.checkpoint_path(d_async, 1)[0]
-    assert open(pa, "rb").read() == open(pb, "rb").read()
+
+    def split(path):
+        data = open(path, "rb").read()
+        man_off, man_len, _crc, tag = ckpt._DWC2_FOOTER.unpack(
+            data[-ckpt._DWC2_FOOTER.size:]
+        )
+        assert tag == b"DWC2"
+        man = json.loads(data[man_off:man_off + man_len])
+        return data[:man_off], man
+
+    chunks_a, man_a = split(pa)
+    chunks_b, man_b = split(pb)
+    assert chunks_a == chunks_b
+    lin_a, lin_b = man_a.pop("lineage"), man_b.pop("lineage")
+    assert man_a == man_b
+    assert lin_a["step"] == lin_b["step"] == 1
 
 
 def test_async_snapshot_immune_to_mutation(tmp_path):

@@ -83,7 +83,7 @@ def test_verify_clean_blob(tmp_path):
     d = str(tmp_path)
     write_steps(d, [1])
     rep = ckpt.verify_checkpoint(_blob(d, 1))
-    assert rep["manifest_version"] == 2
+    assert rep["manifest_version"] == 3
     assert rep["chunks"] == rep["verified_chunks"] > 0
 
 

@@ -1,0 +1,653 @@
+"""Model-lineage observability plane (ISSUE 17): checkpoint manifest v3
+provenance stamping, legacy v1/v2 degradation to the explicit
+``lineage_unknown`` marker at every restore entry point, serving-step
+attribution (headers, cache keys, cache-hit spans), the ``/fleet``
+step-skew field, obs/merge.py lineage timelines over mixed streams,
+obs_tail --trace/--lineage, and the prod_soak --smoke contract."""
+
+import json
+import os
+import sys
+import threading
+import zlib
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+from ddlpc_tpu.config import FleetConfig
+from ddlpc_tpu.obs import lineage as obs_lineage
+from ddlpc_tpu.obs import merge
+from ddlpc_tpu.obs.tracing import Tracer
+from ddlpc_tpu.serve.cache import response_key
+from ddlpc_tpu.serve.router import FleetRouter
+from ddlpc_tpu.train import checkpoint as ckpt
+
+from test_router import FakeReplica, make_router  # noqa: E402
+
+TILE = 32
+
+
+# ---------------------------------------------------------------------------
+# obs/lineage.py basics
+# ---------------------------------------------------------------------------
+
+
+def test_make_lineage_has_every_field_and_flattens():
+    lin = obs_lineage.make_lineage(7)
+    assert set(obs_lineage.LINEAGE_FIELDS) <= set(lin)
+    assert lin["step"] == 7
+    assert isinstance(lin["saved_at"], float)
+    flat = obs_lineage.flatten(lin)
+    # lineage_id keeps its natural name; the rest are prefixed.
+    assert flat["lineage_id"] == lin["lineage_id"]
+    assert flat["lineage_step"] == 7
+    assert flat["lineage_run_id"] == lin["run_id"]
+    assert all(not isinstance(v, dict) for v in flat.values())
+
+
+def test_unknown_lineage_marker_and_flatten_of_non_dict():
+    unk = obs_lineage.unknown_lineage(3)
+    assert obs_lineage.is_unknown(unk)
+    assert unk["lineage_id"] == obs_lineage.LINEAGE_UNKNOWN
+    assert unk["step"] == 3 and unk["saved_at"] is None
+    # Anything that isn't a lineage dict flattens to the unknown marker —
+    # consumers never crash on a legacy record.
+    flat = obs_lineage.flatten(None)
+    assert flat["lineage_id"] == obs_lineage.LINEAGE_UNKNOWN
+
+
+def test_code_fingerprint_is_stable_and_hexish():
+    a, b = obs_lineage.code_fingerprint(), obs_lineage.code_fingerprint()
+    assert a == b and len(a) == 16
+    int(a, 16)  # hex
+
+
+# ---------------------------------------------------------------------------
+# manifest v3 round-trip + legacy degradation
+# ---------------------------------------------------------------------------
+
+
+def _state(seed: int = 0):
+    rng = np.random.default_rng(seed)
+    return {"w": rng.normal(size=(64,)).astype(np.float32), "step": seed}
+
+
+def _save(d: str, step: int, metadata=None):
+    ckpt.save_checkpoint(d, _state(step), step=step, metadata=metadata)
+    return ckpt.checkpoint_path(d, step)[0]
+
+
+def _strip_lineage(d: str, step: int, version: int = 2) -> None:
+    """Rewrite a fresh v3 checkpoint as a legacy v1/v2 one: no lineage in
+    sidecar or manifest, old manifest version, matching old footer."""
+    path = ckpt.checkpoint_path(d, step)[0]
+    data = open(path, "rb").read()
+    man_off, man_len, _crc, tag = ckpt._DWC2_FOOTER.unpack(
+        data[-ckpt._DWC2_FOOTER.size:]
+    )
+    assert tag == b"DWC2"
+    man = json.loads(data[man_off:man_off + man_len])
+    man.pop("lineage", None)
+    man["version"] = version
+    man_bytes = json.dumps(man).encode()
+    if version >= 2:
+        footer = ckpt._DWC2_FOOTER.pack(
+            man_off, len(man_bytes), zlib.crc32(man_bytes), b"DWC2"
+        )
+    else:
+        footer = ckpt._DWC_FOOTER.pack(man_off, len(man_bytes), b"DWCK")
+    with open(path, "wb") as f:
+        f.write(data[:man_off] + man_bytes + footer)
+    side = os.path.join(d, f"ckpt_{step}.json")
+    meta = json.load(open(side))
+    meta.pop("lineage", None)
+    with open(side, "w") as f:
+        json.dump(meta, f)
+
+
+def test_manifest_v3_roundtrip_preserves_trainer_lineage(tmp_path):
+    d = str(tmp_path / "ck")
+    lin = obs_lineage.make_lineage(1, run_id="a" * 16, config_hash_hex="b" * 16)
+    path = _save(d, 1, metadata={"lineage": lin})
+    # The blob manifest itself carries the record (tail read, no restore).
+    man_lin = ckpt.read_manifest_lineage(path)
+    assert man_lin is not None
+    assert man_lin["lineage_id"] == lin["lineage_id"]
+    assert man_lin["run_id"] == "a" * 16
+    # saved_at is restamped at the durable write, never older than ours.
+    assert man_lin["saved_at"] >= lin["saved_at"]
+    _, meta = ckpt.restore_checkpoint(d, _state(1))
+    assert meta["lineage"]["lineage_id"] == lin["lineage_id"]
+
+
+def test_bare_save_synthesizes_lineage(tmp_path):
+    d = str(tmp_path / "ck")
+    _save(d, 2)  # no metadata at all
+    _, meta = ckpt.restore_checkpoint(d, _state(2))
+    lin = meta["lineage"]
+    assert not obs_lineage.is_unknown(lin)
+    assert lin["step"] == 2 and isinstance(lin["saved_at"], float)
+
+
+@pytest.mark.parametrize("version", [1, 2])
+def test_legacy_checkpoint_restores_with_unknown_marker(tmp_path, version):
+    d = str(tmp_path / "ck")
+    path = _save(d, 1)
+    _strip_lineage(d, 1, version=version)
+    # Tail read degrades to None, restore to the explicit marker — never
+    # a crash at the library entry point.
+    assert ckpt.read_manifest_lineage(path) is None
+    restored, meta = ckpt.restore_checkpoint(d, _state(1))
+    np.testing.assert_array_equal(restored["w"], _state(1)["w"])
+    assert obs_lineage.is_unknown(meta["lineage"])
+    assert meta["lineage"]["lineage_id"] == obs_lineage.LINEAGE_UNKNOWN
+
+
+def test_legacy_monolithic_checkpoint_restores_with_unknown_marker(tmp_path):
+    d = str(tmp_path / "ck")
+    ckpt.save_checkpoint(d, _state(1), step=1, format="monolithic")
+    side = os.path.join(d, "ckpt_1.json")
+    meta = json.load(open(side))
+    meta.pop("lineage", None)
+    with open(side, "w") as f:
+        json.dump(meta, f)
+    restored, meta = ckpt.restore_checkpoint(d, _state(1))
+    np.testing.assert_array_equal(restored["w"], _state(1)["w"])
+    assert obs_lineage.is_unknown(meta["lineage"])
+
+
+def test_read_manifest_lineage_tolerates_garbage(tmp_path):
+    p = str(tmp_path / "not_a_ckpt.dwc")
+    with open(p, "wb") as f:
+        f.write(b"garbage" * 10)
+    assert ckpt.read_manifest_lineage(p) is None
+
+
+def test_newest_checkpoint_lineage_walks_sidecars(tmp_path):
+    d = str(tmp_path)
+    ckd = os.path.join(d, "checkpoints")
+    _save(ckd, 1)
+    _save(ckd, 5)
+    lin = obs_lineage.newest_checkpoint_lineage(d)
+    assert lin is not None and lin["step"] == 5
+    assert obs_lineage.newest_checkpoint_lineage(str(tmp_path / "no")) is None
+
+
+# ---------------------------------------------------------------------------
+# the three restore entry points degrade, never crash (jax/serve tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def legacy_run(tmp_path_factory):
+    """A restorable run whose checkpoint predates lineage (stripped to a
+    v2 manifest + lineage-free sidecar)."""
+    from scripts.serve_bench import make_tiny_run
+
+    d = str(tmp_path_factory.mktemp("legacy_run"))
+    make_tiny_run(d, tile=TILE, num_classes=4, seed=0, step=1)
+    _strip_lineage(os.path.join(d, "checkpoints"), 1)
+    return d
+
+
+def test_entrypoint_engine_from_workdir_legacy(legacy_run):
+    from ddlpc_tpu.serve.engine import InferenceEngine
+
+    eng = InferenceEngine.from_workdir(legacy_run)
+    assert obs_lineage.is_unknown(eng.lineage)
+    assert eng.checkpoint_step == 1
+
+
+def test_entrypoint_engine_reload_legacy_then_fresh(legacy_run, tmp_path):
+    from scripts.serve_bench import make_tiny_run
+    from ddlpc_tpu.serve.engine import InferenceEngine
+
+    eng = InferenceEngine.from_workdir(legacy_run)
+    fresh = str(tmp_path / "fresh")
+    make_tiny_run(fresh, tile=TILE, num_classes=4, seed=1, step=2)
+    meta = eng.reload(workdir=fresh)
+    # A lineage-stamped checkpoint replaces the unknown marker atomically
+    # with the weights swap.
+    assert not obs_lineage.is_unknown(eng.lineage)
+    assert meta["lineage"]["lineage_id"] == eng.lineage["lineage_id"]
+    meta = eng.reload(workdir=legacy_run)
+    assert obs_lineage.is_unknown(eng.lineage)
+    assert eng.checkpoint_step == 1
+
+
+def test_entrypoint_predict_cli_legacy(legacy_run, tmp_path):
+    import imageio.v2 as imageio
+
+    from ddlpc_tpu.predict import main as predict_main
+
+    in_dir = tmp_path / "imgs"
+    in_dir.mkdir()
+    rng = np.random.default_rng(0)
+    imageio.imwrite(
+        in_dir / "t.png",
+        rng.integers(0, 255, (TILE, TILE, 3), dtype=np.uint8),
+    )
+    out_dir = tmp_path / "preds"
+    assert predict_main(
+        ["--workdir", legacy_run, "--input", str(in_dir),
+         "--output", str(out_dir)]
+    ) == 0
+    assert os.listdir(out_dir) == ["t_pred.png"]
+
+
+def test_entrypoint_trainer_resume_legacy(legacy_run):
+    # The trainer's own restore path: _restore_step meta always carries a
+    # lineage dict; a legacy checkpoint yields the explicit marker.
+    _, meta = ckpt.restore_checkpoint(
+        os.path.join(legacy_run, "checkpoints"), None
+    )
+    assert obs_lineage.is_unknown(meta["lineage"])
+
+
+def test_serve_healthz_carries_lineage(legacy_run, tmp_path):
+    from scripts.serve_bench import make_tiny_run
+    from ddlpc_tpu.config import ServeConfig
+    from ddlpc_tpu.serve.engine import InferenceEngine
+    from ddlpc_tpu.serve.server import ServingFrontend
+
+    fresh = str(tmp_path / "fresh")
+    make_tiny_run(fresh, tile=TILE, num_classes=4, seed=0, step=3)
+    fe = ServingFrontend(
+        InferenceEngine.from_workdir(fresh), ServeConfig(workdir=fresh)
+    )
+    try:
+        h = fe.healthz()
+        assert h["lineage_id"] != obs_lineage.LINEAGE_UNKNOWN
+        assert isinstance(h["lineage_saved_at"], float)
+    finally:
+        fe.close()
+    fe = ServingFrontend(
+        InferenceEngine.from_workdir(legacy_run),
+        ServeConfig(workdir=legacy_run),
+    )
+    try:
+        h = fe.healthz()
+        assert h["lineage_id"] == obs_lineage.LINEAGE_UNKNOWN
+        assert h["lineage_saved_at"] is None
+    finally:
+        fe.close()
+
+
+# ---------------------------------------------------------------------------
+# router: scraped lineage, cache identity, cache-hit span, step skew
+# ---------------------------------------------------------------------------
+
+
+def test_response_key_includes_lineage_and_none_is_prelineage():
+    body = b"tile"
+    k_none = response_key(body, 1, "none")
+    assert response_key(body, 1, "none", lineage_id=None) == k_none
+    k_a = response_key(body, 1, "none", lineage_id="aaaa")
+    k_b = response_key(body, 1, "none", lineage_id="bbbb")
+    assert len({k_none, k_a, k_b}) == 3
+
+
+def test_scrape_picks_up_lineage_and_cache_identity_consensus():
+    r0 = FakeReplica("r0", health={"lineage_id": "lid1",
+                                   "lineage_saved_at": 100.0})
+    r1 = FakeReplica("r1", health={"lineage_id": "lid1",
+                                   "lineage_saved_at": 100.0})
+    router = make_router([r0, r1], cache_max_bytes=1 << 20)
+    router.scrape_once()
+    ident = router._cache_identity()
+    assert ident == (1, "none", "lid1")
+    # Mixed lineage (mid-reload) degrades the lineage component to None —
+    # caching continues on the pre-lineage key, never a refusal.
+    r1.health["lineage_id"] = "lid2"
+    router.scrape_once()
+    assert router._cache_identity() == (1, "none", None)
+    # The unknown marker is treated as no lineage, not as a real id.
+    r0.health["lineage_id"] = obs_lineage.LINEAGE_UNKNOWN
+    r1.health["lineage_id"] = obs_lineage.LINEAGE_UNKNOWN
+    router.scrape_once()
+    assert router._cache_identity() == (1, "none", None)
+
+
+class TracedFakeReplica(FakeReplica):
+    """FakeReplica that accepts the traceparent kwarg traced attempts add."""
+
+    def predict(self, body, query, timeout_s, cancel=None, traceparent=None):
+        return super().predict(body, query, timeout_s, cancel=cancel)
+
+
+def test_cache_hit_emits_span_and_is_breaker_neutral(tmp_path):
+    spans_path = str(tmp_path / "router_spans.jsonl")
+    r0 = TracedFakeReplica("r0", health={"lineage_id": "lid9",
+                                         "lineage_saved_at": 50.0})
+    cfg = FleetConfig(
+        hedge_ms=0.0, retry_backoff_ms=0.0, scrape_every_s=0.0,
+        metrics_every_s=0.0, cache_max_bytes=1 << 20,
+    )
+    tracer = Tracer(enabled=True, service="router", jsonl_path=spans_path)
+    router = FleetRouter(cfg, tracer=tracer)
+    router.add_replica("r0", r0)
+    router.scrape_once()
+    body = b"scene-tile"
+    info1, info2 = {}, {}
+    assert router.dispatch(body, info=info1)[0] == 200
+    assert router.dispatch(body, info=info2)[0] == 200
+    # Second answer came from the cache: the replica saw exactly one
+    # predict (breaker-neutral by construction — no attempt was made).
+    assert r0.calls == 1
+    assert info1 == {
+        "cache_hit": False, "replica": "r0", "model_step": 1,
+        "lineage_id": "lid9",
+    }
+    assert info2["cache_hit"] is True
+    assert info2["model_step"] == 1 and info2["lineage_id"] == "lid9"
+    tracer.flush()
+    spans = [json.loads(ln) for ln in open(spans_path) if ln.strip()]
+    hits = [s for s in spans if s.get("name") == "cache_hit"]
+    assert len(hits) == 1
+    hit = hits[0]
+    # The span closes the formerly-dangling trace: id + lineage on it.
+    assert isinstance(hit["trace_id"], str) and len(hit["trace_id"]) == 32
+    assert hit["lineage_id"] == "lid9"
+    assert hit["model_step"] == 1 and hit["status"] == 200
+
+
+def test_fleet_endpoint_reports_step_skew_mid_reload_and_converged():
+    import http.client
+
+    from ddlpc_tpu.serve.fleet import make_fleet_server
+
+    r0 = FakeReplica("r0", health={"checkpoint_step": 1})
+    r1 = FakeReplica("r1", health={"checkpoint_step": 3})
+    router = make_router([r0, r1])
+    router.scrape_once()
+    server = make_fleet_server(router, port=0)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        def fleet():
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+            try:
+                conn.request("GET", "/fleet")
+                return json.loads(conn.getresponse().read())
+            finally:
+                conn.close()
+
+        out = fleet()
+        # Mid-rolling-reload: a mixed-weights window is visible as
+        # nonzero skew on the operator's fleet endpoint.
+        assert out["step_skew"] == 2
+        rows = {s["name"]: s for s in out["replica_status"]}
+        assert rows["r0"]["checkpoint_step"] == 1
+        assert rows["r1"]["checkpoint_step"] == 3
+        r0.health["checkpoint_step"] = 3
+        router.scrape_once()
+        assert fleet()["step_skew"] == 0
+    finally:
+        server.shutdown()
+
+
+def test_router_freshness_gauges_from_scrape(tmp_path):
+    # A workdir with a newer durable checkpoint than either replica
+    # serves: per-replica age = newest saved_at - serving saved_at, the
+    # fleet series is the stalest live replica, skew spans the steps.
+    d = str(tmp_path)
+    ckd = os.path.join(d, "checkpoints")
+    _save(ckd, 9)
+    newest = obs_lineage.newest_checkpoint_lineage(d)["saved_at"]
+    r0 = FakeReplica("r0", health={
+        "checkpoint_step": 1,
+        "lineage_id": "old1", "lineage_saved_at": newest - 30.0,
+    })
+    r1 = FakeReplica("r1", health={
+        "checkpoint_step": 2,
+        "lineage_id": "old2", "lineage_saved_at": newest - 10.0,
+    })
+    router = make_router([r0, r1], workdir=d)
+    router.scrape_once()
+    snap = router.registry.snapshot()
+    assert snap['ddlpc_serve_model_age_s{replica="r0"}'] == pytest.approx(
+        30.0, abs=1e-3
+    )
+    assert snap['ddlpc_serve_model_age_s{replica="r1"}'] == pytest.approx(
+        10.0, abs=1e-3
+    )
+    assert snap['ddlpc_serve_model_age_s{replica="fleet"}'] == pytest.approx(
+        30.0, abs=1e-3
+    )
+    assert snap["ddlpc_fleet_step_skew"] == 1.0
+    # A replica with the unknown marker gets NO invented age.
+    r1.health.pop("lineage_saved_at")
+    r1.health["lineage_id"] = obs_lineage.LINEAGE_UNKNOWN
+    router.scrape_once()
+    snap = router.registry.snapshot()
+    assert snap['ddlpc_serve_model_age_s{replica="fleet"}'] == pytest.approx(
+        30.0, abs=1e-3
+    )
+
+
+# ---------------------------------------------------------------------------
+# obs/merge.py: lineage timeline + cache-hit attribution on mixed streams
+# ---------------------------------------------------------------------------
+
+
+def _mixed_records():
+    """A realistic merged stream: trainer save, serve reloads, fleet
+    serving, a routed request, a cache-hit request, and an autoscale
+    event — everything the lineage timeline must stitch."""
+    lid = "abcd" * 4
+    return [
+        {"kind": "lineage", "event": "checkpoint_saved", "time": 100.0,
+         "lineage_id": lid, "lineage_step": 5, "lineage_saved_at": 100.0},
+        {"kind": "span", "name": "checkpoint_snapshot", "time": 99.5,
+         "dur_s": 0.5, "lineage_id": lid, "step": 5, "service": "train",
+         "pid": 10},
+        {"kind": "serve_reload", "time": 101.0, "lineage_id": lid,
+         "lineage_step": 5, "step": 5},
+        {"kind": "autoscale", "time": 101.5, "action": "scale_up",
+         "replicas": 2},
+        {"kind": "lineage", "event": "fleet_serving", "time": 103.0,
+         "lineage_id": lid, "lineage_step": 5, "deploy_latency_s": 3.0},
+        {"kind": "span", "name": "route_request", "time": 104.0,
+         "dur_s": 0.1, "trace_id": "t1" * 16, "status": 200,
+         "model_step": 5, "lineage_id": lid, "service": "router", "pid": 11},
+        {"kind": "span", "name": "router_attempt", "time": 104.01,
+         "dur_s": 0.08, "trace_id": "t1" * 16, "status": 200,
+         "replica": "r0", "span_hex": "aa" * 8, "reason": "primary",
+         "service": "router", "pid": 11},
+        {"kind": "span", "name": "cache_hit", "time": 105.0, "dur_s": 0.001,
+         "trace_id": "t2" * 16, "status": 200, "model_step": 5,
+         "lineage_id": lid, "service": "router", "pid": 11},
+    ]
+
+
+def test_lineage_timeline_derives_deploy_latency():
+    recs = _mixed_records()
+    lid = "abcd" * 4
+    tl = merge.lineage_timeline(recs, lid)
+    assert tl["lineage_id"] == lid
+    assert tl["saved_at"] == 100.0
+    assert tl["fleet_serving_at"] == 103.0
+    assert tl["deploy_latency_s"] == 3.0
+    # Save record+span, reload, fleet_serving, both request roots — the
+    # attempt span carries no lineage_id (its identity lives on the root).
+    assert tl["records"] == 6
+    assert tl["requests_served"] == 2
+    kinds = {e["event"] for e in tl["events"]}
+    assert {"checkpoint_saved", "fleet_serving", "checkpoint_snapshot"} <= kinds
+
+
+def test_filter_lineage_excludes_other_records():
+    recs = _mixed_records()
+    got = merge.filter_lineage(recs, "abcd" * 4)
+    assert all(r.get("lineage_id") == "abcd" * 4 for r in got)
+    assert not any(r.get("kind") == "autoscale" for r in got)
+
+
+def test_attribution_handles_cache_hit_trace():
+    recs = _mixed_records()
+    out = merge.attribution(recs, "t2" * 16)
+    assert out["cache_hit"] is True
+    assert out["attempts"] == 0
+    assert out["model_step"] == 5
+    assert out["lineage_id"] == "abcd" * 4
+    assert out["status"] == 200
+    # Routed trace still attributes normally, now with lineage identity.
+    routed = merge.attribution(recs, "t1" * 16)
+    assert routed["cache_hit"] is False
+    assert routed["model_step"] == 5
+    assert routed["winner_replica"] == "r0"
+
+
+def test_summarize_requests_includes_cache_hit_roots():
+    rows = merge.summarize_requests(_mixed_records())
+    by_trace = {r["trace_id"]: r for r in rows}
+    assert set(by_trace) == {"t1" * 16, "t2" * 16}
+    assert by_trace["t2" * 16]["cache_hit"] is True
+
+
+def test_read_records_merges_all_kinds_in_time_order(tmp_path):
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    recs = _mixed_records()
+    with open(a, "w") as f:
+        for r in recs[:4]:
+            f.write(json.dumps(r) + "\n")
+    with open(b, "w") as f:
+        for r in recs[4:]:
+            f.write(json.dumps(r) + "\n")
+        f.write("torn{line\n")
+    got = merge.read_records([a, b, str(tmp_path / "missing.jsonl")])
+    assert len(got) == len(recs)
+    assert [r["time"] for r in got] == sorted(r["time"] for r in recs)
+    assert {r["_src"] for r in got} == {"a.jsonl", "b.jsonl"}
+
+
+# ---------------------------------------------------------------------------
+# obs_tail --trace / --lineage
+# ---------------------------------------------------------------------------
+
+
+def _write_stream(path, recs):
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_obs_tail_trace_filter(tmp_path, capsys):
+    import obs_tail
+
+    p = str(tmp_path / "s.jsonl")
+    _write_stream(p, [
+        {"schema": 1, "time": 1.0, "kind": "span", "trace_id": "tt1"},
+        {"schema": 1, "time": 2.0, "kind": "span", "trace_id": "other"},
+        {"schema": 1, "time": 3.0, "kind": "span",
+         "trace_ids": ["x", "tt1"]},  # a batch span serving the request
+        {"schema": 1, "time": 4.0, "kind": "train", "loss": 1.0},
+    ])
+    assert obs_tail.main([p, "-n", "0", "--trace", "tt1"]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert [json.loads(l.split("\t", 1)[1])["time"] for l in lines] == [1.0, 3.0]
+
+
+def test_obs_tail_lineage_filter_across_streams(tmp_path, capsys):
+    import obs_tail
+
+    a, b = str(tmp_path / "train.jsonl"), str(tmp_path / "router.jsonl")
+    _write_stream(a, [
+        {"schema": 1, "time": 1.0, "kind": "lineage",
+         "event": "checkpoint_saved", "lineage_id": "L1"},
+        {"schema": 1, "time": 5.0, "kind": "lineage",
+         "event": "checkpoint_saved", "lineage_id": "L2"},
+    ])
+    _write_stream(b, [
+        {"schema": 1, "time": 3.0, "kind": "lineage",
+         "event": "fleet_serving", "lineage_id": "L1"},
+        {"schema": 1, "time": 4.0, "kind": "router", "event": "cache_invalidate"},
+    ])
+    assert obs_tail.main([a, b, "-n", "0", "--lineage", "L1"]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    recs = [json.loads(l.split("\t", 1)[1]) for l in lines]
+    # Merged time order across both streams, only L1's story.
+    assert [r["time"] for r in recs] == [1.0, 3.0]
+    assert {r["event"] for r in recs} == {"checkpoint_saved", "fleet_serving"}
+
+
+# ---------------------------------------------------------------------------
+# prod_soak --smoke (tier-1 arm) + the committed evidence
+# ---------------------------------------------------------------------------
+
+
+def _good_report():
+    return {
+        "schema": 1,
+        "survived": True,
+        "reloads_ok": 6,
+        "train": {"goodput_ratio": 0.97},
+        "deploy_latency_p95_s": 2.5,
+        "load": {"error_fraction": 0.0, "error_budget": 0.02},
+        "lineage": {"unresolved_samples": 0, "sampled_headers": 120},
+        "step_skew": {"final": 0},
+        "schema_lint_violations": 0,
+    }
+
+
+def test_prod_soak_smoke_accepts_good_report(tmp_path, capsys):
+    import prod_soak
+
+    p = str(tmp_path / "r.json")
+    with open(p, "w") as f:
+        json.dump(_good_report(), f)
+    assert prod_soak.main(["--smoke", "--baseline", p]) == 0
+    assert "prod_soak_smoke_ok=1" in capsys.readouterr().out
+
+
+@pytest.mark.parametrize("breakage", [
+    {"survived": False},
+    {"reloads_ok": 4},
+    {"train": {"goodput_ratio": 0.5}},
+    {"deploy_latency_p95_s": None},
+    {"load": {"error_fraction": 0.1, "error_budget": 0.02}},
+    {"lineage": {"unresolved_samples": 3, "sampled_headers": 120}},
+    {"step_skew": {"final": 2}},
+])
+def test_prod_soak_smoke_rejects_each_breakage(tmp_path, breakage):
+    import prod_soak
+
+    rep = _good_report()
+    rep.update(breakage)
+    p = str(tmp_path / "r.json")
+    with open(p, "w") as f:
+        json.dump(rep, f)
+    assert prod_soak.main(["--smoke", "--baseline", p]) == 1
+
+
+def test_prod_soak_smoke_on_committed_evidence():
+    """The committed soak report must keep passing its own acceptance
+    thresholds — same contract as perf_gate --smoke on its baselines."""
+    import prod_soak
+
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "docs", "resilience",
+        "prod_soak.json",
+    )
+    assert os.path.exists(path), "docs/resilience/prod_soak.json missing"
+    assert prod_soak.smoke(path) == 0
+
+
+# ---------------------------------------------------------------------------
+# schema registration
+# ---------------------------------------------------------------------------
+
+
+def test_lineage_and_prod_soak_kinds_are_registered():
+    from ddlpc_tpu.obs.schema import KNOWN_KINDS, stamp
+
+    assert "lineage" in KNOWN_KINDS and "prod_soak" in KNOWN_KINDS
+    rec = stamp(
+        {"event": "checkpoint_saved",
+         **obs_lineage.flatten(obs_lineage.make_lineage(1))},
+        kind="lineage",
+    )
+    assert rec["kind"] == "lineage"
