@@ -110,6 +110,7 @@ class Arm:
     shard_update: bool = False      # ZeRO-1 layout
     spatial: bool = False           # data×space mesh, GSPMD step
     serve_quantize: str = "off"     # serve arms only
+    bucket_mb: float = 0.0          # comm/compute overlap bucket target
 
     @property
     def comm_variant(self) -> Optional[str]:
@@ -122,12 +123,13 @@ class Arm:
         return "allreduce"
 
     def declared_wire_dtype(self) -> str:
-        """The dtype the arm CLAIMS is on the wire.  The simulate
-        transport physically moves fp32 (the codec is an information-loss
-        model — obs/comm.py documents the convention), so its honest
-        declaration is f32; the ring transport puts real quantized
-        integers on every hop.  The future fused-collectives PR narrows
-        the simulate declaration — and this auditor is what proves it."""
+        """The dtype the arm CLAIMS is on the wire.  The ring transport
+        puts real quantized integers on every hop; the fused simulate
+        path puts the lattice itself on the collective operand wherever
+        the sums fit the narrow dtype exactly — the declaration mirrors
+        ``grad_sync.simulate_wire_dtype`` (the single source of truth for
+        when the fusion engages) and the HLO dtype-flow + closed-form
+        contracts are what prove it."""
         if self.transport == "ring" and self.mode != "none":
             import jax.numpy as jnp
 
@@ -138,6 +140,11 @@ class Arm:
             return hlo_mod.hlo_dtype_name(
                 jnp.dtype(wire_dtype(AXIS_SIZE, levels_for(comp)))
             )
+        if self.comm_variant in ("allreduce", "scatter"):
+            from ddlpc_tpu.obs.comm import simulate_wire_row
+
+            name, _ = simulate_wire_row(self.compression(), AXIS_SIZE)
+            return name
         return "f32"
 
     def compression(self):
@@ -149,6 +156,7 @@ class Arm:
             rounding=self.rounding,
             quantize_local=self.quantize_local,
             quantize_mean=self.quantize_mean,
+            bucket_mb=self.bucket_mb,
         )
 
 
@@ -172,6 +180,16 @@ ARMS: Dict[str, Arm] = {
         Arm("none_gspmd", spatial=True),
         Arm("fp16_gspmd", mode="float16", spatial=True, quantize_local=False),
         Arm("gspmd_zero1", spatial=True, shard_update=True),
+        # Bucketed comm/compute overlap arms: the same tiny tree split
+        # into several size-targeted buckets (0.02 MiB yields B > 1 on
+        # the audit model) — one fused collective per bucket, per-bucket
+        # scales, and the census parity across the three layouts is what
+        # pins that every layout derives the identical partition.
+        Arm("int8_bucketed", mode="int8", bucket_mb=0.02),
+        Arm("fp16_bucketed_zero1", mode="float16", shard_update=True,
+            bucket_mb=0.02),
+        Arm("fp16_bucketed_gspmd", mode="float16", spatial=True,
+            quantize_local=False, bucket_mb=0.02),
         Arm("serve_fp32"),
         Arm("serve_int8", serve_quantize="int8"),
         Arm("serve_bf16", serve_quantize="bf16"),
@@ -187,7 +205,7 @@ ARMS: Dict[str, Arm] = {
 # donation/sharding of the whole state).
 _TRAIN_ARMS = (
     "none_simulate", "int8_simulate", "int8_zero1", "int8_ring",
-    "none_gspmd", "fp16_gspmd", "gspmd_zero1",
+    "none_gspmd", "fp16_gspmd", "gspmd_zero1", "fp16_bucketed_gspmd",
 )
 
 
@@ -314,9 +332,11 @@ def _tree_elements(tree) -> int:
     return total
 
 
-def _chunk_padding_bytes(tree, n_shards: int) -> int:
-    """fp32 bytes the [N, K] chunk layout adds over the exact element
-    count (shard_update.chunk_rows padding), per full-tree collective."""
+def _chunk_padding_bytes(tree, n_shards: int, itemsize: int = 4) -> int:
+    """Bytes the [N, K] chunk layout adds over the exact element count
+    (shard_update.chunk_rows padding), per full-tree collective, at the
+    collective's operand itemsize (the fused scatter pads WIRE-dtype
+    elements; the params all-gather pads fp32)."""
     import jax
 
     from ddlpc_tpu.parallel.shard_update import chunk_rows
@@ -327,7 +347,7 @@ def _chunk_padding_bytes(tree, n_shards: int) -> int:
         for d in leaf.shape:
             size *= int(d)
         pad += n_shards * chunk_rows(size, n_shards) - size
-    return pad * 4
+    return pad * itemsize
 
 
 # --------------------------------------------------------------------------
@@ -348,7 +368,8 @@ class Declared:
     axis_size: int = 1
     rs_pad_bytes: int = 0       # zero1 chunk padding on the grad scatter
     ag_pad_bytes: int = 0       # zero1 chunk padding on the params publish
-    has_scale_collective: bool = False  # live pmax of the global scale
+    scale_collectives: int = 0  # live scalar pmaxes of the global scale(s)
+    n_buckets: int = 1          # bucket_mb partition size (grad_bucket_groups)
     has_dead_norm_psum: bool = False    # jaxpr-only psum DCE'd by XLA
     # tree of per-leaf expected shard element counts (None = skip audit)
     sharding_in: Any = None
@@ -372,10 +393,15 @@ class ProgramBundle:
     patch: Optional[Callable] = None
 
 
-def expected_fences(arm: Arm, kind: str) -> int:
+def expected_fences(arm: Arm, kind: str, n_buckets: int = 1) -> int:
     """Barrier count the configuration implies (grad_sync.py /
     train_step.py fencing rules — the single place the expectation is
-    written down, so a dropped fence is a COUNT mismatch, not a vibe)."""
+    written down, so a dropped fence is a COUNT mismatch, not a vibe).
+    Every quantize stage runs once per bucket (``n_buckets`` =
+    grad_bucket_groups of the audited tree), each inside its own fence
+    pair: the fused wire encode keeps apply_codec_fenced's cut points
+    and count, the dequantize is deliberately unfenced (one scalar
+    multiply cannot FMA-contract — grad_sync._fenced_wire_encode)."""
     if kind in ("eval_step", "serve_forward"):
         return 0
     fences = 2  # _fenced_update pins the optimizer chain
@@ -383,12 +409,15 @@ def expected_fences(arm: Arm, kind: str) -> int:
     if not quantizing:
         return fences
     if arm.spatial:
-        return fences + 2  # one apply_codec_fenced on the mean gradient
+        # one apply_codec_fenced on the mean gradient, per bucket
+        return fences + 2 * n_buckets
     if arm.transport == "ring":
         # The N>1 ring owns its own quantized collective; no XLA-level
         # codec stages exist to fence (compressed_allreduce.py).
         return fences
-    fences += 2 * int(arm.quantize_local) + 2 * int(arm.quantize_mean)
+    fences += n_buckets * (
+        2 * int(arm.quantize_local) + 2 * int(arm.quantize_mean)
+    )
     return fences
 
 
@@ -528,22 +557,43 @@ def build_program(name: str) -> ProgramBundle:
         make_update_step,
     )
 
+    from ddlpc_tpu.parallel.grad_sync import grad_bucket_groups
+
+    n_buckets = len(grad_bucket_groups(state.params, comp.bucket_mb))
     declared = Declared(
         comm_variant=arm.comm_variant,
         wire_dtype=arm.declared_wire_dtype(),
-        fences=expected_fences(arm, kind),
+        fences=expected_fences(arm, kind, n_buckets),
         n_grad=n_grad,
         n_param=n_grad,
         axis_size=mesh.shape["data"],
+        n_buckets=n_buckets,
     )
     quantizing = comp.mode != "none"
+    # One live scalar pmax per global scale: the fused wire encode shares
+    # its scale across replicas (per bucket), and the scatter's mean
+    # stage pmaxes the chunked absmax back to the global one (per
+    # bucket).  The non-fused fake-quantize stages use local scales — no
+    # collective.
+    fused = declared.wire_dtype != "f32" and arm.comm_variant in (
+        "allreduce", "scatter"
+    )
+    if arm.comm_variant == "allreduce":
+        declared.scale_collectives = n_buckets if fused else 0
     if arm.shard_update and not arm.spatial:
-        declared.rs_pad_bytes = _chunk_padding_bytes(state.params, AXIS_SIZE)
-        declared.ag_pad_bytes = declared.rs_pad_bytes
-        declared.has_scale_collective = quantizing and comp.quantize_mean
+        wire_item = hlo_mod.max_operand_itemsize(declared.wire_dtype)
+        declared.rs_pad_bytes = _chunk_padding_bytes(
+            state.params, AXIS_SIZE, wire_item
+        )
+        declared.ag_pad_bytes = _chunk_padding_bytes(
+            state.params, AXIS_SIZE, 4
+        )
+        declared.scale_collectives = n_buckets * (
+            int(fused) + int(quantizing and comp.quantize_mean)
+        )
         declared.has_dead_norm_psum = True
     if arm.comm_variant == "ring":
-        declared.has_scale_collective = True
+        declared.scale_collectives = 1
 
     if kind == "update_step":
         fn = make_update_step(
@@ -873,23 +923,29 @@ def check_comm_closed_form(
         return []
     comp = bundle.arm.compression()
     plan = comm_plan(
-        d.n_grad, d.n_param, comp, d.axis_size, d.comm_variant
+        d.n_grad, d.n_param, comp, d.axis_size, d.comm_variant,
+        n_buckets=d.n_buckets,
     )
     expected: Dict[Tuple[str, str], int] = {}
-    if d.comm_variant == "allreduce":
-        expected[("all-reduce", "f32")] = plan[0]["bytes_pre"]
-    elif d.comm_variant == "scatter":
-        expected[("reduce-scatter", "f32")] = (
-            plan[0]["bytes_pre"] + d.rs_pad_bytes
-        )
-        expected[("all-gather", "f32")] = (
-            plan[1]["bytes_pre"] + d.ag_pad_bytes
-        )
+    if d.comm_variant in ("allreduce", "scatter"):
+        # The plan's bytes_wire is payload + one fp32 scale per bucket;
+        # in the program those are SEPARATE collectives — the narrow
+        # payload reduce and the scalar scale pmax(es), the latter
+        # accounted in scalar_bytes below.
+        row = plan[0]
+        wire = str(row["wire_dtype"])
+        scale_in_wire = 0 if wire == "f32" else SCALE_BYTES * d.n_buckets
+        payload = int(row["bytes_wire"]) - scale_in_wire
+        if d.comm_variant == "allreduce":
+            expected[("all-reduce", wire)] = payload
+        else:
+            expected[("reduce-scatter", wire)] = payload + d.rs_pad_bytes
+            expected[("all-gather", "f32")] = (
+                int(plan[1]["bytes_wire"]) + d.ag_pad_bytes
+            )
     elif d.comm_variant == "ring":
         expected[("collective-permute", d.wire_dtype)] = plan[0]["bytes_post"]
-    scalar_bytes = 0
-    if d.has_scale_collective:
-        scalar_bytes += SCALE_BYTES
+    scalar_bytes = SCALE_BYTES * d.scale_collectives
     if d.has_dead_norm_psum and level == "jaxpr":
         scalar_bytes += 4  # psum of the f32[] grad-norm partial (DCE'd by XLA)
     if scalar_bytes:
@@ -922,24 +978,26 @@ def check_dtype_flow(
 ) -> List[ProgramViolation]:
     """No wire collective may be fed a dtype wider than the arm declares.
 
-    Scalar control collectives (the global-scale pmax, the grad-norm
-    psum) are exempt — they are not the gradient payload.  On arms that
-    declare a quantized wire (ring today; the fused simulate path
-    tomorrow), an fp32 operand here is exactly the "int8 grads widened to
-    fp32 before the wire" regression the fused-collectives PR must not
-    reintroduce."""
+    Scalar control collectives (the global-scale pmaxes, the grad-norm
+    psum) are exempt — they are not the gradient payload; XLA's
+    all-reduce combiner may merge several of them into one op, so the
+    exemption budget is the DECLARED scalar count, not ops-in-row.  On
+    arms that declare a quantized wire (ring, and the fused simulate
+    path), an fp32 operand here is exactly the "int8 grads widened to
+    fp32 before the wire" regression this contract exists to catch."""
     d = bundle.declared
     if d.comm_variant is None:
         return []
     declared_bytes = hlo_mod.max_operand_itemsize(d.wire_dtype)
+    scalar_budget = d.scale_collectives + int(d.has_dead_norm_psum)
     out: List[ProgramViolation] = []
     for r in rows:
         if r["kind"] not in (
             "all-reduce", "reduce-scatter", "collective-permute"
         ):
             continue
-        if int(r["elements"]) <= int(r["count"]):
-            continue  # scalar control collective
+        if int(r["elements"]) <= max(int(r["count"]), scalar_budget):
+            continue  # scalar control collective(s)
         width = hlo_mod.max_operand_itemsize(str(r["dtype"]))
         if width > declared_bytes:
             out.append(
@@ -1447,14 +1505,28 @@ def build_injection(which: str) -> ProgramBundle:
         )
 
     if which == "fp32-widen":
-        # The fused-collectives claim, audited against today's simulate
-        # program: declare the wire int8 and the auditor must catch the
-        # fp32 operands actually feeding the all-reduce.
+        # The fused wire really IS s8 now, so the widening regression is
+        # demonstrated by tracing with the fusion disabled
+        # (simulate_wire_dtype -> None: grad_sync falls back to the fp32
+        # pmean spelling) while the declaration keeps the honest s8 —
+        # exactly what a refactor that quietly reroutes the sync around
+        # the fused path would look like.  jax resolves grad_sync's
+        # module global at TRACE time, so the patch rides the bundle.
+        import contextlib
+
+        @contextlib.contextmanager
+        def widened():
+            from ddlpc_tpu.parallel import grad_sync
+
+            real = grad_sync.simulate_wire_dtype
+            grad_sync.simulate_wire_dtype = lambda axis_size, comp: None
+            try:
+                yield
+            finally:
+                grad_sync.simulate_wire_dtype = real
+
         bundle = build_program("int8_simulate/update_step")
-        return replace(
-            bundle, name="inject/fp32-widen",
-            declared=replace(bundle.declared, wire_dtype="s8"),
-        )
+        return replace(bundle, name="inject/fp32-widen", patch=widened)
 
     if which == "drop-fence":
         # Trace the update program with apply_codec_fenced neutered —

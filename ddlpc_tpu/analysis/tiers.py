@@ -132,6 +132,10 @@ MODULE_TIERS: Dict[str, str] = {
     "ddlpc_tpu.parallel": JAX,
     "ddlpc_tpu.parallel.mesh": JAX,
     "ddlpc_tpu.parallel.halo": JAX,
+    # jax-free by construction (obs/comm and tooling compute bucket
+    # assignments without the accelerator stack), but the implicit
+    # parent-package edge pins it to the parallel package's tier.
+    "ddlpc_tpu.parallel.bucketing": JAX,
     "ddlpc_tpu.parallel.grad_sync": JAX,
     "ddlpc_tpu.parallel.compressed_allreduce": JAX,
     "ddlpc_tpu.parallel.shard_update": JAX,

@@ -372,6 +372,17 @@ class CompressionConfig:
     # kernel with hardware-PRNG stochastic rounding, ops/pallas_quantize.py).
     # The ring transport keeps its own inlined formula either way.
     codec_backend: str = "xla"  # xla | pallas
+    # Comm/compute overlap: split the gradient tree into size-targeted
+    # buckets (MiB of fp32 gradient per bucket, greedy over flatten order —
+    # parallel/bucketing.py) and issue each bucket's fused quantized
+    # collective separately, so backward compute of earlier layers can
+    # overlap sync of later ones (the standard DDP trick the paper's
+    # 50-microbatch accumulation was approximating).  0 (default) keeps
+    # today's single whole-tree sync — bit-identical to pre-bucketing
+    # programs.  Buckets quantize with per-bucket scales at both loss
+    # points; simulate transport only (the ring's flatten/concat transport
+    # is inherently whole-tree and rejects bucket_mb > 0).
+    bucket_mb: float = 0.0
 
 
 @dataclass(frozen=True)

@@ -9,12 +9,14 @@ of the step is communication.  This module owns both:
   closed-form per-step logical payload bytes for every collective the
   step variants issue (``parallel/grad_sync.py``'s ``sync_gradients`` /
   ``sync_gradients_scatter``, ``parallel/compressed_allreduce.py``'s
-  ring), pre- and post-codec, published as
+  ring), pre-codec, post-codec and on-the-wire, published as
   ``ddlpc_comm_bytes_total{collective,codec,stage}`` counters and a
   ``ddlpc_comm_compression_ratio`` gauge.  "Logical" means the tensor
   bytes a replica contributes to the collective — what a compressed wire
-  format carries; the simulate transport physically moves fp32 regardless
-  (the codec is an information-loss model there), the ring transport's
+  format carries; after the fused rewrite the simulate transport's
+  collective operand really IS that narrow dtype wherever the lattice
+  sums fit it exactly (``grad_sync.simulate_wire_dtype`` — the ``wire``
+  stage rows), fp32 only on the fallback paths; the ring transport's
   numbers are its REAL per-hop wire bytes (``ring_wire_report``).
   Exactness is the contract: int8 → ``n·1 + 4`` (one global fp32 scale),
   float16 → ``n·2 + 4``, none → ``n·4`` (test-pinned against closed
@@ -59,15 +61,35 @@ def tree_elements(tree) -> int:
     )
 
 
-def codec_payload_bytes(n_elements: int, mode: str) -> int:
+def codec_payload_bytes(n_elements: int, mode: str, n_scales: int = 1) -> int:
     """Logical payload bytes for ``n_elements`` after the codec: the wire
-    dtype's bytes plus the global scale scalar (quantizing modes only)."""
+    dtype's bytes plus the global scale scalar(s) (quantizing modes only —
+    bucketed syncs carry one fp32 scale per bucket)."""
     if mode not in CODEC_ITEMSIZE:
         raise ValueError(f"unknown compression mode {mode!r}")
     nbytes = n_elements * CODEC_ITEMSIZE[mode]
     if mode != "none":
-        nbytes += SCALE_BYTES
+        nbytes += SCALE_BYTES * n_scales
     return nbytes
+
+
+def simulate_wire_row(compression, axis_size: int):
+    """(hlo_dtype_name, itemsize) of the simulate transport's grad
+    collective operand — the ACTUAL dtype on the wire after the fused
+    rewrite (grad_sync.simulate_wire_dtype), distinct from the codec's
+    declared loss model: 's8'/'s16'/'f16' when the lattice sums fit the
+    narrow dtype, 'f32' otherwise (mode='none', quantize_local=False, or
+    an axis too large for exact narrow sums)."""
+    from ddlpc_tpu.parallel.grad_sync import simulate_wire_dtype
+
+    wire = simulate_wire_dtype(axis_size, compression)
+    if wire is None:
+        return "f32", 4
+    import numpy as np
+
+    dt = np.dtype(wire)
+    name = {"int8": "s8", "int16": "s16", "float16": "f16"}[dt.name]
+    return name, dt.itemsize
 
 
 def comm_plan(
@@ -76,6 +98,7 @@ def comm_plan(
     compression,
     axis_size: int,
     variant: str,
+    n_buckets: int = 1,
 ) -> List[Dict[str, object]]:
     """Per-optimizer-step collective rows for one step variant.
 
@@ -86,9 +109,17 @@ def comm_plan(
     the wire payload is fp32 — train_step.py documents why).
 
     Each row: ``collective``, ``codec`` (the mode the wire payload is in),
-    ``bytes_pre`` (fp32 bytes entering the codec) and ``bytes_post``
-    (bytes leaving it), per replica per step.  Singleton meshes
-    communicate nothing → empty plan.
+    ``bytes_pre`` (fp32 bytes entering the codec), ``bytes_post`` (the
+    DECLARED loss-model payload leaving it — the historical convention,
+    kept stable so old streams stay comparable), plus ``wire_dtype`` and
+    ``bytes_wire`` — the ACTUAL HLO collective operand bytes after the
+    fused rewrite: the narrow lattice payload plus one fp32 scale pmax
+    per bucket where the fused path engages, fp32 otherwise (chunk
+    padding depends on leaf shapes and is accounted exactly by the
+    program auditor, not here).  ``n_buckets`` is the bucket count of
+    ``CompressionConfig.bucket_mb`` (grad_sync.grad_bucket_groups): each
+    bucket carries its own scale.  Singleton meshes communicate nothing
+    → empty plan.
     """
     if axis_size <= 1:
         return []
@@ -98,22 +129,34 @@ def comm_plan(
         # quantize_local is the codec stage ahead of the wire; without it
         # (or with mode none) the payload stays fp32.
         wire_mode = mode if (mode != "none" and compression.quantize_local) else "none"
+        wire_name, wire_item = simulate_wire_row(compression, axis_size)
+        scale_bytes = 0 if wire_name == "f32" else SCALE_BYTES * n_buckets
         return [
             {
                 "collective": "all_reduce",
                 "codec": wire_mode,
                 "bytes_pre": fp32,
-                "bytes_post": codec_payload_bytes(n_grad_elements, wire_mode),
+                "bytes_post": codec_payload_bytes(
+                    n_grad_elements, wire_mode, n_buckets
+                ),
+                "wire_dtype": wire_name,
+                "bytes_wire": n_grad_elements * wire_item + scale_bytes,
             }
         ]
     if variant == "scatter":
         wire_mode = mode if (mode != "none" and compression.quantize_local) else "none"
+        wire_name, wire_item = simulate_wire_row(compression, axis_size)
+        scale_bytes = 0 if wire_name == "f32" else SCALE_BYTES * n_buckets
         return [
             {
                 "collective": "reduce_scatter",
                 "codec": wire_mode,
                 "bytes_pre": fp32,
-                "bytes_post": codec_payload_bytes(n_grad_elements, wire_mode),
+                "bytes_post": codec_payload_bytes(
+                    n_grad_elements, wire_mode, n_buckets
+                ),
+                "wire_dtype": wire_name,
+                "bytes_wire": n_grad_elements * wire_item + scale_bytes,
             },
             # The fresh-params publish of the ZeRO-1 update: uncompressed
             # by construction (params, not grads).
@@ -122,6 +165,8 @@ def comm_plan(
                 "codec": "none",
                 "bytes_pre": n_param_elements * 4,
                 "bytes_post": n_param_elements * 4,
+                "wire_dtype": "f32",
+                "bytes_wire": n_param_elements * 4,
             },
         ]
     if variant == "ring":
@@ -133,11 +178,24 @@ def comm_plan(
                     "codec": "none",
                     "bytes_pre": fp32,
                     "bytes_post": fp32,
+                    "wire_dtype": "f32",
+                    "bytes_wire": fp32,
                 }
             ]
-        from ddlpc_tpu.parallel.compressed_allreduce import ring_wire_report
+        import numpy as np
+
+        from ddlpc_tpu.parallel.compressed_allreduce import (
+            ring_wire_report,
+            wire_dtype as ring_wire_dtype,
+        )
 
         rep = ring_wire_report(n_grad_elements, axis_size, compression)
+        levels = (
+            compression.int8_levels if mode == "int8" else compression.fp16_levels
+        )
+        ring_name = {"int8": "s8", "int16": "s16"}[
+            np.dtype(ring_wire_dtype(axis_size, levels)).name
+        ]
         return [
             {
                 "collective": "ring_all_reduce",
@@ -147,6 +205,9 @@ def comm_plan(
                 # hops), not the logical-payload convention above.
                 "bytes_pre": rep["fp32_bytes_per_replica"],
                 "bytes_post": rep["wire_bytes_per_replica"],
+                # The ring always had the quantized dtype on the wire.
+                "wire_dtype": ring_name,
+                "bytes_wire": rep["wire_bytes_per_replica"],
             }
         ]
     if variant == "gspmd":
@@ -156,6 +217,8 @@ def comm_plan(
                 "codec": "none",
                 "bytes_pre": fp32,
                 "bytes_post": fp32,
+                "wire_dtype": "f32",
+                "bytes_wire": fp32,
             }
         ]
     raise ValueError(f"unknown comm plan variant {variant!r}")
@@ -180,8 +243,10 @@ class CommAccountant:
         self._bytes = registry.counter(
             "ddlpc_comm_bytes_total",
             "Logical collective payload bytes per replica (pre_codec = "
-            "fp32 entering the codec, post_codec = wire payload leaving "
-            "it; ring rows are real per-hop wire bytes).",
+            "fp32 entering the codec, post_codec = the DECLARED loss-"
+            "model payload leaving it, wire = actual HLO collective "
+            "operand bytes — narrow lattice dtype where the fused path "
+            "engages; ring rows are real per-hop wire bytes).",
             labelnames=("collective", "codec", "stage"),
         )
         self._ratio = registry.gauge(
@@ -222,6 +287,12 @@ class CommAccountant:
                 codec=row["codec"],
                 stage="post_codec",
             )
+            self._bytes.inc(
+                row["bytes_wire"] * n,
+                collective=row["collective"],
+                codec=row["codec"],
+                stage="wire",
+            )
         with self._lock:
             self._steps += n
 
@@ -241,6 +312,8 @@ class CommAccountant:
             rec[f"{name}_bytes_pre_per_step"] = row["bytes_pre"]
             rec[f"{name}_bytes_post_per_step"] = row["bytes_post"]
             rec[f"{name}_codec"] = row["codec"]
+            rec[f"{name}_wire_dtype"] = row["wire_dtype"]
+            rec[f"{name}_bytes_wire_per_step"] = row["bytes_wire"]
             rec[f"{name}_compression_ratio"] = round(
                 row["bytes_pre"] / max(row["bytes_post"], 1), 4
             )

@@ -139,6 +139,175 @@ def _fq_leaf(
     return out.reshape(-1)[:n].reshape(x.shape)
 
 
+def _encode_kernel(
+    scale_ref, seed_ref, x_ref, out_ref, *, levels: float, stochastic: bool
+):
+    """Encode-to-wire variant of ``_fq_kernel``: same snap/clip against the
+    (caller-shared) scale, but the OUTPUT is the narrow lattice itself —
+    int8/int16/fp16 for a fused quantized collective — with no dequantize
+    multiply (that happens after the collective, on 1/N or summed data)."""
+    x = x_ref[...].astype(jnp.float32)
+    scaled = x / scale_ref[0, 0] * levels
+    if stochastic:
+        pltpu.prng_seed(seed_ref[0, 0] + pl.program_id(0))
+        bits = pltpu.bitcast(pltpu.prng_random_bits(scaled.shape), jnp.uint32)
+        u = pltpu.bitcast(bits >> 8, jnp.int32).astype(jnp.float32) * (
+            1.0 / (1 << 24)
+        )
+        snapped = jnp.floor(scaled + u)
+    else:
+        snapped = jnp.round(scaled)
+    out_ref[...] = jnp.clip(snapped, -levels, levels).astype(out_ref.dtype)
+
+
+def _encode_kernel_hostnoise(scale_ref, x_ref, u_ref, out_ref, *, levels: float):
+    x = x_ref[...].astype(jnp.float32)
+    scaled = x / scale_ref[0, 0] * levels
+    snapped = jnp.clip(jnp.floor(scaled + u_ref[...]), -levels, levels)
+    out_ref[...] = snapped.astype(out_ref.dtype)
+
+
+def _decode_kernel(inv_ref, q_ref, out_ref):
+    """Dequantize wire values: one multiply by the runtime scalar
+    ``inv = scale / (levels · axis_size)`` — a single rounding, so it is
+    bit-identical to the XLA spelling of the same multiply."""
+    out_ref[...] = q_ref[...].astype(jnp.float32) * inv_ref[0, 0]
+
+
+def _sublane_multiple(dtype) -> int:
+    """Minimum second-to-last tile dimension per dtype (TPU tiling): 8
+    sublanes for 32-bit, 16 for 16-bit, 32 for 8-bit operands."""
+    itemsize = jnp.dtype(dtype).itemsize
+    return {1: 32, 2: 16}.get(itemsize, 8)
+
+
+def _wire_block_layout(x: jax.Array, wire_dtype):
+    """Ravel/pad ``x`` to [rows, LANES] with rows a whole number of blocks
+    sized for the NARROW dtype's tile multiple (int8 tiles are (32, 128),
+    fp16 (16, 128) — the fp32 input trivially satisfies both)."""
+    flat = x.ravel()
+    n = flat.shape[0]
+    mult = _sublane_multiple(wire_dtype)
+    rows = -(-n // LANES)
+    block_rows = min(_BLOCK_ROWS, -(-rows // mult) * mult)
+    rows_padded = -(-rows // block_rows) * block_rows
+    padded = jnp.pad(flat, (0, rows_padded * LANES - n)).reshape(
+        rows_padded, LANES
+    )
+    return padded, n, rows_padded // block_rows, block_rows
+
+
+def _encode_leaf(
+    x: jax.Array,
+    safe_scale: jax.Array,
+    levels: float,
+    seed: Optional[jax.Array],
+    wire_dtype,
+    interpret: bool,
+) -> jax.Array:
+    padded, n, n_blocks, block_rows = _wire_block_layout(x, wire_dtype)
+    block = lambda: pl.BlockSpec(  # noqa: E731 — identical specs
+        (block_rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    scale_arg = safe_scale.reshape(1, 1).astype(jnp.float32)
+    if seed is not None and interpret:
+        u = jax.random.uniform(jax.random.key(jnp.abs(seed)), padded.shape)
+        out = pl.pallas_call(
+            functools.partial(_encode_kernel_hostnoise, levels=levels),
+            out_shape=jax.ShapeDtypeStruct(padded.shape, wire_dtype),
+            grid=(n_blocks,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                block(),
+                block(),
+            ],
+            out_specs=block(),
+            interpret=True,
+        )(scale_arg, padded, u)
+    else:
+        out = pl.pallas_call(
+            functools.partial(
+                _encode_kernel, levels=levels, stochastic=seed is not None
+            ),
+            out_shape=jax.ShapeDtypeStruct(padded.shape, wire_dtype),
+            grid=(n_blocks,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),  # scale (1,1)
+                pl.BlockSpec(memory_space=pltpu.SMEM),  # seed (1,1)
+                block(),
+            ],
+            out_specs=block(),
+            interpret=interpret,
+        )(
+            scale_arg,
+            (jnp.zeros((1, 1), jnp.int32) if seed is None else seed.reshape(1, 1)),
+            padded,
+        )
+    return out.reshape(-1)[:n].reshape(x.shape)
+
+
+def encode_to_wire_pallas(
+    tree,
+    cfg: CompressionConfig,
+    safe_scale: jax.Array,
+    wire_dtype,
+    key: Optional[jax.Array] = None,
+    interpret: bool = False,
+):
+    """Encode a gradient pytree to its WIRE dtype: the lattice values
+    themselves (int8/int16/fp16), quantized against a caller-supplied
+    scale — the pmax-shared global scale of the fused collective path
+    (grad_sync._fenced_wire_encode) — with no dequantize pass.  Nearest
+    rounding lands on integer lattice points, so the cast output is
+    bit-identical to the XLA ``quantize_with_scale(...).astype(wire)``
+    spelling (unlike fake-quantize, there is no dequant multiply to
+    FMA-contract differently).  Seeds mirror ``fake_quantize_pallas``."""
+    levels = float(levels_for(cfg))
+    key = rounding_key(cfg, key)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if key is None:
+        seeds = [None] * len(leaves)
+    else:
+        seeds = list(
+            jax.random.randint(
+                key, (len(leaves),), jnp.iinfo(jnp.int32).min, jnp.iinfo(jnp.int32).max
+            )
+        )
+    out = [
+        _encode_leaf(l, safe_scale, levels, s, wire_dtype, interpret)
+        for l, s in zip(leaves, seeds)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def decode_from_wire_pallas(
+    tree, inv_step: jax.Array, interpret: bool = False
+):
+    """Dequantize summed wire values: ``q · inv_step`` per element, where
+    ``inv_step = scale / (levels · axis_size)`` folds the mean division
+    into the one runtime-scalar multiply (quantize.decode's convention).
+    Deliberately NOT fenced — the fused path leaves the decode free to
+    fuse into the collective's consumer (grad_sync._wire_decode)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    inv_arg = inv_step.reshape(1, 1).astype(jnp.float32)
+    out = []
+    for q in leaves:
+        padded, n, n_blocks, block_rows = _wire_block_layout(q, q.dtype)
+        block = lambda: pl.BlockSpec(  # noqa: E731 — identical specs
+            (block_rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+        )
+        dec = pl.pallas_call(
+            _decode_kernel,
+            out_shape=jax.ShapeDtypeStruct(padded.shape, jnp.float32),
+            grid=(n_blocks,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM), block()],
+            out_specs=block(),
+            interpret=interpret,
+        )(inv_arg, padded)
+        out.append(dec.reshape(-1)[:n].reshape(q.shape))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def fake_quantize_pallas(
     tree,
     cfg: CompressionConfig,

@@ -1,0 +1,72 @@
+"""Size-targeted gradient buckets for comm/compute overlap.
+
+The DDP trick (PAPERS.md: PyTorch DDP, Horovod): instead of one whole-tree
+gradient sync at the end of backward, partition the leaves into buckets of
+roughly ``bucket_mb`` MiB and issue each bucket's collective as its grads
+become available, so backward compute of earlier layers overlaps the sync
+of later ones.  The source paper approximated the same hiding with
+50-microbatch accumulation; buckets hide the wire *within* one sync.
+
+Assignment is a pure function of the leaf byte sizes in flatten order —
+greedy: walk the leaves, open a new bucket whenever adding the next leaf
+would exceed the target and the current bucket is non-empty.  Purity is
+the load-bearing property: the replicated, ZeRO-1 and GSPMD step builders
+all derive their buckets from the same parameter tree, so every layout
+sees the *same* partition (the program auditor's collective census counts
+the buckets per layout and pins that they agree), and replicated↔sharded
+bit-identity (docs/SHARDING.md) is preserved bucket-for-bucket.
+
+Deliberately dependency-free (stdlib only): the assignment must be
+computable by observability code (``obs/comm.py`` byte accounting) and
+tooling without touching jax.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+MIB = float(1 << 20)
+
+
+def assign_buckets(leaf_bytes: Sequence[int], bucket_mb: float) -> List[int]:
+    """Bucket index per leaf (flatten order) for a greedy ``bucket_mb`` MiB
+    target.  ``bucket_mb <= 0`` means "no bucketing": every leaf lands in
+    bucket 0 and the sync degenerates to today's single whole-tree
+    collective.  A leaf larger than the target gets a bucket of its own
+    (never split); the last bucket is whatever remains (usually under
+    target).  Indices are contiguous starting at 0."""
+    if bucket_mb <= 0 or not leaf_bytes:
+        return [0] * len(leaf_bytes)
+    target = bucket_mb * MIB
+    out: List[int] = []
+    bucket = 0
+    acc = 0.0
+    for nbytes in leaf_bytes:
+        if acc > 0 and acc + nbytes > target:
+            bucket += 1
+            acc = 0.0
+        out.append(bucket)
+        acc += nbytes
+    return out
+
+
+def bucket_index_groups(
+    leaf_bytes: Sequence[int], bucket_mb: float
+) -> List[List[int]]:
+    """Leaf indices grouped per bucket, in bucket order — the iteration
+    order every step builder uses, so bucket ``b`` means the same leaves
+    in every layout."""
+    assignment = assign_buckets(leaf_bytes, bucket_mb)
+    n_buckets = (max(assignment) + 1) if assignment else 1
+    groups: List[List[int]] = [[] for _ in range(n_buckets)]
+    for i, b in enumerate(assignment):
+        groups[b].append(i)
+    return groups
+
+
+def bucket_count(leaf_bytes: Sequence[int], bucket_mb: float) -> int:
+    """How many buckets ``assign_buckets`` produces — the ``B`` in the
+    auditor's fence/byte closed forms and ``obs/comm.py``'s scale-byte
+    accounting."""
+    assignment = assign_buckets(leaf_bytes, bucket_mb)
+    return (max(assignment) + 1) if assignment else 1

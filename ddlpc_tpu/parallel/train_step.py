@@ -494,12 +494,15 @@ def make_train_step_gspmd(
         )
         if compression.mode != "none":
             from ddlpc_tpu.parallel.grad_sync import (
-                apply_codec_fenced,
+                apply_codec_fenced_bucketed,
                 resolve_codec_backend,
             )
 
             rng = _rounding_rng(compression, seed, state.step)
-            grads = apply_codec_fenced(
+            # Bucketed spelling so the GSPMD codec loss (per-bucket scales
+            # and keys) matches the shard_map layouts bucket-for-bucket;
+            # bucket_mb=0 degenerates to the single fenced whole-tree stage.
+            grads = apply_codec_fenced_bucketed(
                 resolve_codec_backend(compression), grads, compression, key=rng
             )
         params, opt_state = _fenced_update(

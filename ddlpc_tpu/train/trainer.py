@@ -320,10 +320,22 @@ class Trainer:
             else:
                 variant = "allreduce"
             n_params = obs_comm.tree_elements(self.state.params)
+            from ddlpc_tpu.parallel.grad_sync import grad_bucket_groups
+
+            n_buckets = len(
+                grad_bucket_groups(
+                    self.state.params, cfg.compression.bucket_mb
+                )
+            )
             self.comm = obs_comm.CommAccountant(
                 self.registry,
                 obs_comm.comm_plan(
-                    n_params, n_params, cfg.compression, data_size, variant
+                    n_params,
+                    n_params,
+                    cfg.compression,
+                    data_size,
+                    variant,
+                    n_buckets=n_buckets,
                 ),
                 variant,
             )

@@ -9,8 +9,11 @@ makes the cheap arms REPLAYABLE and COMPARABLE: it re-measures
 - ``train_step_ms``    — the full compiled train step (fwd/bwd ×
   sync_period + sync + update) on the same tiny model;
 - ``comm_fraction``    — the fenced comm-only probe (obs/comm.py) over
-  ``train_step_ms``: the step attribution number the future
-  comm/compute-overlap work is judged against;
+  ``train_step_ms``: the step attribution number the comm/compute
+  overlap work is judged against;
+- ``comm_fraction_overlapped`` — the same probe/step pair measured with
+  ``CompressionConfig.bucket_mb`` set, i.e. the sync issued as
+  per-bucket fused quantized collectives (the overlapped spelling);
 - ``loader_tiles_per_s`` — the ShardedLoader host gather→cast→upload
   path on a synthetic dataset;
 - ``serve_p99_ms``     — the closed-loop serving load
@@ -71,6 +74,14 @@ GATED = {
     "update_step_ms": dict(unit="ms", direction="lower", tolerance=0.08),
     "train_step_ms": dict(unit="ms", direction="lower", tolerance=0.25),
     "comm_fraction": dict(unit="ratio", direction="lower", tolerance=0.50),
+    # The overlapped arm (ISSUE 18): the same comm-only probe and train
+    # step measured with CompressionConfig.bucket_mb set, i.e. the sync
+    # issued as per-bucket fused collectives.  Gated so the overlap
+    # machinery cannot silently regress back toward the whole-tree
+    # fraction; compared against comm_fraction in docs/PERF.md "Overlap".
+    "comm_fraction_overlapped": dict(
+        unit="ratio", direction="lower", tolerance=0.50
+    ),
     "loader_tiles_per_s": dict(
         unit="tiles/s", direction="higher", tolerance=0.50
     ),
@@ -99,6 +110,28 @@ GATED = {
 # --------------------------------------------------------------------------
 
 
+# Source modules on the measured path of the step/comm arms: a baseline
+# whose stamp predates an edit to any of these describes code that no
+# longer runs — the gate must SAY so (ISSUE 18 bugfix), not hold the old
+# bands with a straight face.  Relative to the repo root.
+MEASURED_PATH_MODULES = (
+    "ddlpc_tpu/config.py",
+    "ddlpc_tpu/obs/comm.py",
+    "ddlpc_tpu/ops/pallas_quantize.py",
+    "ddlpc_tpu/ops/quantize.py",
+    "ddlpc_tpu/parallel/bucketing.py",
+    "ddlpc_tpu/parallel/compressed_allreduce.py",
+    "ddlpc_tpu/parallel/grad_sync.py",
+    "ddlpc_tpu/parallel/shard_update.py",
+    "ddlpc_tpu/parallel/train_step.py",
+    "bench.py",
+)
+
+
+def measured_path_files(repo: str = _REPO) -> List[str]:
+    return [os.path.join(repo, rel) for rel in MEASURED_PATH_MODULES]
+
+
 def host_fingerprint() -> Dict[str, object]:
     """What the baseline's numbers were measured ON.  Compared (not
     hashed) so a mismatch warning can say WHICH dimension moved."""
@@ -116,6 +149,7 @@ def baseline_warnings(
     baseline: dict, max_age_days: float,
     now: Optional[float] = None,
     current_host: Optional[Dict[str, object]] = None,
+    measured_paths: Optional[List[str]] = None,
 ) -> List[str]:
     """Staleness/provenance warnings for a loaded baseline (ISSUE 14
     satellite).  NON-FATAL by design — the gate still compares — but loud:
@@ -141,6 +175,25 @@ def baseline_warnings(
                 f"— its tolerance bands may no longer describe this tree; "
                 f"regenerate with --update-baseline"
             )
+        if measured_paths:
+            # mtime vs stamp: a baseline older than an edit to a module
+            # on the measured path pins numbers the current code never
+            # produced.  Loud, never fatal — same policy as age.
+            newer = []
+            for path in measured_paths:
+                try:
+                    mtime = os.path.getmtime(path)
+                except OSError:
+                    continue
+                if mtime > float(generated_at):
+                    newer.append(os.path.relpath(path, _REPO))
+            if newer:
+                warnings.append(
+                    "baseline predates changes to measured-path "
+                    f"module(s): {', '.join(sorted(newer))} — its numbers "
+                    "describe code that no longer runs; re-measure with "
+                    "--update-baseline"
+                )
     recorded = baseline.get("host")
     if not isinstance(recorded, dict):
         warnings.append(
@@ -248,7 +301,9 @@ def smoke(
         for e in errs:
             print(f"perf_gate --smoke: {e}")
         return 1
-    for w in baseline_warnings(baseline, max_age_days):
+    for w in baseline_warnings(
+        baseline, max_age_days, measured_paths=measured_path_files()
+    ):
         print(f"perf_gate --smoke: WARNING: {w}", file=sys.stderr)
 
     from ddlpc_tpu.analysis.program import (  # jax-import-free validators
@@ -327,9 +382,20 @@ def _tiny_cfg():
     )
 
 
+# Bucket target for the overlapped arm: the tiny model is ~0.074 MiB of
+# fp32 gradient, so 0.02 MiB yields several buckets — the same partition
+# the program auditor's bucketed arms pin (analysis/program.py).
+OVERLAP_BUCKET_MB = 0.02
+
+
 def arm_step_and_comm(rounds: int) -> Dict[str, float]:
     """update_step_ms, train_step_ms, comm_ms_per_step, comm_fraction,
-    overlap_headroom_ms on the tiny config over all available devices."""
+    overlap_headroom_ms on the tiny config over all available devices,
+    plus the overlapped arm: the same comm probe and train step with
+    ``bucket_mb=OVERLAP_BUCKET_MB`` (per-bucket fused collectives) →
+    comm_fraction_overlapped."""
+    import dataclasses
+
     import jax
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -402,6 +468,31 @@ def arm_step_and_comm(rounds: int) -> Dict[str, float]:
         times.append((time.perf_counter() - t0) / 4)
     step_ms = float(np.median(times)) * 1e3
     frac = min(comm_ms / step_ms, 1.0) if step_ms > 0 else 0.0
+
+    # Overlapped arm: identical model/optimizer/load, sync issued as
+    # per-bucket fused collectives.  The probe measures the bucketed
+    # comm-only program; the step measures the bucketed train step the
+    # trainer would actually run at this bucket_mb.
+    comp_b = dataclasses.replace(
+        cfg.compression, bucket_mb=OVERLAP_BUCKET_MB
+    )
+    probe_b = make_comm_probe(
+        mesh, comp_b, param_shapes, scatter=sharded, seed=cfg.train.seed
+    )
+    comm_b_ms = min(probe_b() for _ in range(max(rounds, 2))) * 1e3
+    step_b = make_train_step(model, tx, mesh, comp_b, shard_update=sharded)
+    for _ in range(2):
+        state, metrics = step_b(state, images, labels)
+        float(metrics["loss"])
+    times_b = []
+    for _ in range(max(rounds, 3)):
+        t0 = time.perf_counter()
+        for _ in range(4):
+            state, metrics = step_b(state, images, labels)
+        float(metrics["loss"])
+        times_b.append((time.perf_counter() - t0) / 4)
+    step_b_ms = float(np.median(times_b)) * 1e3
+    frac_b = min(comm_b_ms / step_b_ms, 1.0) if step_b_ms > 0 else 0.0
     return {
         "update_step_ms": round(update_ms, 3),
         "train_step_ms": round(step_ms, 3),
@@ -410,6 +501,10 @@ def arm_step_and_comm(rounds: int) -> Dict[str, float]:
         "overlap_headroom_ms": round(
             max(min(comm_ms, step_ms - comm_ms), 0.0), 3
         ),
+        "comm_fraction_overlapped": round(frac_b, 4),
+        "comm_ms_per_step_bucketed": round(comm_b_ms, 3),
+        "train_step_bucketed_ms": round(step_b_ms, 3),
+        "overlap_bucket_mb": OVERLAP_BUCKET_MB,
     }
 
 
@@ -578,7 +673,11 @@ def build_baseline(measured: Dict[str, float]) -> dict:
         "attribution": {
             k: v
             for k, v in measured.items()
-            if k in ("comm_ms_per_step", "overlap_headroom_ms")
+            if k in (
+                "comm_ms_per_step", "overlap_headroom_ms",
+                "comm_ms_per_step_bucketed", "train_step_bucketed_ms",
+                "overlap_bucket_mb",
+            )
         },
     }
 
@@ -686,7 +785,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         for e in errs:
             print(f"perf_gate: {e}", file=sys.stderr)
         return 2
-    for w in baseline_warnings(baseline, args.max_baseline_age_days):
+    for w in baseline_warnings(
+        baseline, args.max_baseline_age_days,
+        measured_paths=measured_path_files(),
+    ):
         print(f"perf_gate: WARNING: {w}", file=sys.stderr)
     failures = compare(baseline["metrics"], measured, inject=inject)
     for fail in failures:
