@@ -214,12 +214,18 @@ HEADLINE = "unet_vaihingen512"
 
 
 def measure_update_ms(
-    tx, mesh, compression, state, shard_update: bool, rounds: int = TIMED_ROUNDS
+    tx, mesh, compression, state, shard_update: str,
+    rounds: int = TIMED_ROUNDS, param_avals=None,
 ) -> float:
-    """Time the weight-update path alone (grad sync + optimizer + — when
-    sharded — the params all-gather) via the update-only compiled program
+    """Time the weight-update path alone (grad sync + optimizer + the
+    level's own collectives) via the update-only compiled program
     (train_step.make_update_step).  ``state`` must already be in the
-    matching run layout; returns milliseconds per update."""
+    matching run layout; ``param_avals`` supplies the canonical (full)
+    gradient shapes when the placed params are chunked (zero3) — grads
+    enter the update at full shape on every level.  Returns milliseconds
+    per update.  NOTE zero3's number excludes the step-head params
+    all-gather (it belongs to the train step's forward prologue, not the
+    update program) — ``measure_gather_ms`` prices that separately."""
     upd = make_update_step(tx, mesh, compression, shard_update=shard_update)
     rng = np.random.default_rng(1)
     grads = jax.tree.map(
@@ -227,7 +233,7 @@ def measure_update_ms(
             rng.standard_normal(p.shape).astype(np.float32) * 1e-3,
             NamedSharding(mesh, P()),
         ),
-        state.params,
+        param_avals if param_avals is not None else state.params,
     )
     # Private copies: the update program donates its params/opt_state (the
     # realistic in-place layout), which would invalidate the caller's state.
@@ -244,6 +250,50 @@ def measure_update_ms(
         for _ in range(PIPELINE_STEPS):
             params, opt_state = upd(params, opt_state, grads)
         jax.block_until_ready(params)
+        times.append((time.perf_counter() - t0) / PIPELINE_STEPS)
+    return float(np.median(times)) * 1e3
+
+
+def measure_gather_ms(
+    mesh, state, param_avals, data_axis: str = "data",
+    rounds: int = TIMED_ROUNDS,
+) -> float:
+    """Time zero3's step-head params all-gather in isolation: the exact
+    per-leaf ``all_gather`` + reshape the train step's forward prologue
+    runs on the persisted ``[N, K]`` chunks (train_step.shard_body).
+    This is the cost zero3 pays that zero2 does not — priced separately
+    so docs/sharding/update_ab.json states it instead of hiding it in a
+    step time nobody decomposes."""
+    from ddlpc_tpu.parallel import shard_update as zero
+    from ddlpc_tpu.utils.compat import shard_map
+
+    def gather(chunks):
+        return jax.tree.map(
+            lambda ch, av: zero.unchunk_leaf(
+                jax.lax.all_gather(ch, data_axis, axis=0, tiled=True),
+                av.shape,
+            ),
+            chunks,
+            param_avals,
+        )
+
+    # The persisted chunks are [N, K] views sharded P(data) on axis 0 —
+    # the same spec _zero_state_specs commits for zero3 params.
+    fn = jax.jit(
+        shard_map(
+            gather, mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: P(data_axis), param_avals),),
+            out_specs=jax.tree.map(lambda _: P(), param_avals), check=False,
+        )
+    )
+    for _ in range(WARMUP_STEPS):
+        jax.block_until_ready(fn(state.params))
+    times = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        for _ in range(PIPELINE_STEPS):
+            out = fn(state.params)
+        jax.block_until_ready(out)
         times.append((time.perf_counter() - t0) / PIPELINE_STEPS)
     return float(np.median(times)) * 1e3
 
@@ -271,14 +321,16 @@ def run_bench(
         shard_update, cfg.compression, mesh.shape["data"], spatial=False
     )
     layout = StateLayout(
-        "zero1" if sharded else "replicated", tx, state, mesh, "data"
+        "replicated" if sharded == "off" else sharded, tx, state, mesh, "data"
     )
     state = layout.place(state)
     t_update_ms = measure_update_ms(
-        tx, mesh, cfg.compression, state, sharded, rounds=timed_rounds
+        tx, mesh, cfg.compression, state, sharded, rounds=timed_rounds,
+        param_avals=layout.param_avals,
     )
     step = make_train_step(
-        model, tx, mesh, cfg.compression, shard_update=sharded
+        model, tx, mesh, cfg.compression, shard_update=sharded,
+        param_avals=layout.param_avals,
     )
 
     A = spec["sync_period"]
@@ -338,9 +390,10 @@ def run_bench(
         "timing": f"pipelined_{PIPELINE_STEPS}",
         "global_batch": global_batch,
         "sync_period": A,
-        # Weight-update path in isolation (grad sync + Adam + — sharded —
-        # the params all-gather), from the update-only compiled program.
-        "shard_update": bool(sharded),
+        # Weight-update path in isolation (grad sync + Adam + the level's
+        # collectives), from the update-only compiled program.  The
+        # resolved ZeRO level string ("off"|"zero1"|"zero2"|"zero3").
+        "shard_update": sharded,
         "t_update_ms": round(t_update_ms, 3),
     }
 
@@ -425,11 +478,16 @@ print(json.dumps({'n': %(n)d, 'losses': losses, 'step_time_s': dt}))
 
 
 def run_update_ab(rounds: int, out_path: str) -> dict:
-    """Same-host A/B of the weight-update path, replicated vs ZeRO-sharded,
-    at the flagship model size: per-step ``t_update_ms`` both arms plus the
-    per-device optimizer-state bytes each layout keeps resident.  Writes
-    the committed JSON and returns the driver-contract record (the sharded
-    arm's ``update_ms_per_step``)."""
+    """Same-host A/B of the weight-update path across the ZeRO ladder
+    (off / zero1 / zero2 / zero3) at the flagship model size: per-step
+    ``t_update_ms`` each arm plus the per-device params + optimizer-state
+    bytes each layout keeps resident.  The zero3 arm also prices its
+    step-head params all-gather (``params_gather_ms``) — the cost zero3
+    pays every step that zero2 does not, stated separately because the
+    update-only program excludes it by construction.  Writes the
+    committed JSON and returns the driver-contract record (the zero2
+    arm's ``update_ms_per_step`` — zero2 is the ladder's default, PR 5's
+    sharded update renamed)."""
     name = HEADLINE
     spec = BENCHES[name]
     h, w = spec["image"]
@@ -454,26 +512,42 @@ def run_update_ab(rounds: int, out_path: str) -> dict:
     state0 = create_train_state(
         model, tx, jax.random.key(0), (1, max(h // 4, 128), max(w // 4, 128), 3)
     )
-    arms = {}
-    for arm, sharded in (("off", False), ("on", True)):
-        layout = StateLayout(
-            "zero1" if sharded else "replicated", tx, state0, mesh, "data"
-        )
-        state = layout.place(state0)
-        opt_bytes = sum(
+    def _shard0_bytes(tree):
+        return sum(
             s.data.nbytes
-            for leaf in jax.tree.leaves(state.opt_state)
+            for leaf in jax.tree.leaves(tree)
             for s in leaf.addressable_shards[:1]
         )
-        arms[arm] = {
+
+    arms = {}
+    for level in ("off", "zero1", "zero2", "zero3"):
+        layout = StateLayout(
+            "replicated" if level == "off" else level, tx, state0, mesh,
+            "data",
+        )
+        state = layout.place(state0)
+        arms[level] = {
             "t_update_ms": round(
                 measure_update_ms(
-                    tx, mesh, cfg.compression, state, sharded, rounds=rounds
+                    tx, mesh, cfg.compression, state, level, rounds=rounds,
+                    param_avals=layout.param_avals,
                 ),
                 3,
             ),
-            "opt_state_bytes_per_device": opt_bytes,
+            "params_bytes_per_device": _shard0_bytes(state.params),
+            "opt_state_bytes_per_device": _shard0_bytes(state.opt_state),
         }
+        if level == "zero3":
+            # zero3's extra per-step cost: the forward prologue's params
+            # all-gather (not in the update-only program) — priced here
+            # so the artifact states it rather than letting the update
+            # column imply zero3 is free.
+            arms[level]["params_gather_ms"] = round(
+                measure_gather_ms(
+                    mesh, state, layout.param_avals, rounds=rounds
+                ),
+                3,
+            )
     report = {
         "bench": name,
         "devices": n_devices,
@@ -485,7 +559,12 @@ def run_update_ab(rounds: int, out_path: str) -> dict:
         "arms": arms,
         "opt_state_reduction_x": round(
             arms["off"]["opt_state_bytes_per_device"]
-            / max(arms["on"]["opt_state_bytes_per_device"], 1),
+            / max(arms["zero2"]["opt_state_bytes_per_device"], 1),
+            2,
+        ),
+        "params_reduction_x_zero3": round(
+            arms["off"]["params_bytes_per_device"]
+            / max(arms["zero3"]["params_bytes_per_device"], 1),
             2,
         ),
     }
@@ -497,9 +576,12 @@ def run_update_ab(rounds: int, out_path: str) -> dict:
             json.dump(report, f, indent=2)
     return {
         "metric": "update_ms_per_step",
-        "value": arms["on"]["t_update_ms"],
+        "value": arms["zero2"]["t_update_ms"],
         "unit": "ms",
         "replicated_ms": arms["off"]["t_update_ms"],
+        "zero1_ms": arms["zero1"]["t_update_ms"],
+        "zero3_ms": arms["zero3"]["t_update_ms"],
+        "zero3_gather_ms": arms["zero3"]["params_gather_ms"],
         "opt_state_reduction_x": report["opt_state_reduction_x"],
         "devices": n_devices,
     }
@@ -513,10 +595,10 @@ def main() -> None:
     )
     p.add_argument(
         "--shard-update",
-        choices=("auto", "on", "off"),
+        choices=("auto", "on", "off", "zero1", "zero2", "zero3"),
         default="auto",
-        help="ZeRO-1 sharded optimizer update for the benched step "
-        "(auto: on for multi-device meshes — docs/SHARDING.md)",
+        help="ZeRO level of the benched step's weight update (auto/on "
+        "resolve to zero2 on multi-device meshes — docs/SHARDING.md)",
     )
     p.add_argument(
         "--update-ab",

@@ -107,7 +107,7 @@ class Arm:
     rounding: str = "nearest"
     quantize_local: bool = True
     quantize_mean: bool = True
-    shard_update: bool = False      # ZeRO-1 layout
+    shard_update: str = "off"       # off | zero1 | zero2 | zero3
     spatial: bool = False           # data×space mesh, GSPMD step
     serve_quantize: str = "off"     # serve arms only
     bucket_mb: float = 0.0          # comm/compute overlap bucket target
@@ -118,8 +118,10 @@ class Arm:
             return None  # partitioner owns the collectives — baseline-pinned
         if self.transport == "ring" and self.mode != "none":
             return "ring"
-        if self.shard_update:
+        if self.shard_update == "zero2":
             return "scatter"
+        if self.shard_update in ("zero1", "zero3"):
+            return self.shard_update
         return "allreduce"
 
     def declared_wire_dtype(self) -> str:
@@ -140,7 +142,7 @@ class Arm:
             return hlo_mod.hlo_dtype_name(
                 jnp.dtype(wire_dtype(AXIS_SIZE, levels_for(comp)))
             )
-        if self.comm_variant in ("allreduce", "scatter"):
+        if self.comm_variant in ("allreduce", "scatter", "zero1", "zero3"):
             from ddlpc_tpu.obs.comm import simulate_wire_row
 
             name, _ = simulate_wire_row(self.compression(), AXIS_SIZE)
@@ -172,21 +174,33 @@ ARMS: Dict[str, Arm] = {
         Arm("int8_simulate", mode="int8"),
         Arm("fp16_simulate", mode="float16"),
         Arm("int8_stochastic", mode="int8", rounding="stochastic"),
-        Arm("none_zero1", shard_update=True),
-        Arm("int8_zero1", mode="int8", shard_update=True),
-        Arm("fp16_zero1", mode="float16", shard_update=True),
+        # The ZeRO ladder (shard_update.py module docstring): the *_zero2
+        # arms are PR 5's audited programs renamed with the layout
+        # taxonomy fix (they persist SCATTERED grad shards — stage 2);
+        # *_zero1 audits the new true stage-1 program (full-mean
+        # all-reduce + chunked update + params publish), *_zero3 the
+        # params-sharded gather-on-demand program.
+        Arm("none_zero1", shard_update="zero1"),
+        Arm("int8_zero1", mode="int8", shard_update="zero1"),
+        Arm("none_zero2", shard_update="zero2"),
+        Arm("int8_zero2", mode="int8", shard_update="zero2"),
+        Arm("fp16_zero2", mode="float16", shard_update="zero2"),
+        Arm("none_zero3", shard_update="zero3"),
+        Arm("int8_zero3", mode="int8", shard_update="zero3"),
         Arm("int8_ring", mode="int8", transport="ring"),
         Arm("fp16_ring", mode="float16", transport="ring"),
         Arm("none_gspmd", spatial=True),
         Arm("fp16_gspmd", mode="float16", spatial=True, quantize_local=False),
-        Arm("gspmd_zero1", spatial=True, shard_update=True),
+        Arm("gspmd_zero1", spatial=True, shard_update="zero1"),
+        Arm("gspmd_zero2", spatial=True, shard_update="zero2"),
+        Arm("gspmd_zero3", spatial=True, shard_update="zero3"),
         # Bucketed comm/compute overlap arms: the same tiny tree split
         # into several size-targeted buckets (0.02 MiB yields B > 1 on
         # the audit model) — one fused collective per bucket, per-bucket
         # scales, and the census parity across the three layouts is what
         # pins that every layout derives the identical partition.
         Arm("int8_bucketed", mode="int8", bucket_mb=0.02),
-        Arm("fp16_bucketed_zero1", mode="float16", shard_update=True,
+        Arm("fp16_bucketed_zero2", mode="float16", shard_update="zero2",
             bucket_mb=0.02),
         Arm("fp16_bucketed_gspmd", mode="float16", spatial=True,
             quantize_local=False, bucket_mb=0.02),
@@ -204,8 +218,9 @@ ARMS: Dict[str, Arm] = {
 # the aux collectives — batch-stat pmean, metric reductions — and the
 # donation/sharding of the whole state).
 _TRAIN_ARMS = (
-    "none_simulate", "int8_simulate", "int8_zero1", "int8_ring",
-    "none_gspmd", "fp16_gspmd", "gspmd_zero1", "fp16_bucketed_gspmd",
+    "none_simulate", "int8_simulate", "int8_zero1", "int8_zero2",
+    "int8_zero3", "int8_ring", "none_gspmd", "fp16_gspmd", "gspmd_zero1",
+    "gspmd_zero2", "gspmd_zero3", "fp16_bucketed_gspmd",
 )
 
 
@@ -575,51 +590,79 @@ def build_program(name: str) -> ProgramBundle:
     # stage pmaxes the chunked absmax back to the global one (per
     # bucket).  The non-fused fake-quantize stages use local scales — no
     # collective.
+    # zero1 rides the allreduce path's fused wire; zero2 (scatter) and
+    # zero3 ride the scatter sync — all of them share the per-bucket
+    # global scale when the wire is quantized.  Ring keeps its own
+    # single-scale accounting below.
     fused = declared.wire_dtype != "f32" and arm.comm_variant in (
-        "allreduce", "scatter"
+        "allreduce", "scatter", "zero1", "zero3"
     )
-    if arm.comm_variant == "allreduce":
+    level = arm.shard_update
+    if arm.comm_variant in ("allreduce", "zero1"):
+        # zero1's sync IS the allreduce path's (full mean) — same fused
+        # wire, same scale pmaxes; the extra all-gather carries params.
         declared.scale_collectives = n_buckets if fused else 0
-    if arm.shard_update and not arm.spatial:
-        wire_item = hlo_mod.max_operand_itemsize(declared.wire_dtype)
-        declared.rs_pad_bytes = _chunk_padding_bytes(
-            state.params, AXIS_SIZE, wire_item
-        )
+    if level != "off" and not arm.spatial:
+        # Every chunk layout all-gathers [1, K] param chunks (zero1/zero2
+        # publish fresh params at the tail; zero3 gathers on demand at the
+        # head) — fp32 chunk padding either way.
         declared.ag_pad_bytes = _chunk_padding_bytes(
             state.params, AXIS_SIZE, 4
         )
-        declared.scale_collectives = n_buckets * (
-            int(fused) + int(quantizing and comp.quantize_mean)
-        )
-        declared.has_dead_norm_psum = True
+        if level in ("zero2", "zero3"):
+            wire_item = hlo_mod.max_operand_itemsize(declared.wire_dtype)
+            declared.rs_pad_bytes = _chunk_padding_bytes(
+                state.params, AXIS_SIZE, wire_item
+            )
+            declared.scale_collectives = n_buckets * (
+                int(fused) + int(quantizing and comp.quantize_mean)
+            )
+            declared.has_dead_norm_psum = True
     if arm.comm_variant == "ring":
         declared.scale_collectives = 1
 
     if kind == "update_step":
         fn = make_update_step(
-            tx, mesh, comp, shard_update=arm.shard_update,
+            tx, mesh, comp, shard_update=level,
             seed=cfg.train.seed,
         )
         opt_avals = state.opt_state
         opt_spec = jax.tree.map(lambda _: P(), opt_avals)
-        if arm.shard_update:
+        param_in_avals = state.params
+        param_in_elems = _repl_tree(state.params)
+        if level != "off":
             opt_avals = _chunked_opt_avals(state.params, state.opt_state)
             # opt_partition_specs is written over the FULL-layout template;
             # the chunk view replaces leaves 1:1, so the spec tree remaps
             # structurally (chunked leaves: P('data') on chunk axis 0).
             opt_spec = _respec_chunked(
-                zero.opt_partition_specs(tx, state.params, "zero1", "data"),
+                zero.opt_partition_specs(tx, state.params, level, "data"),
                 opt_avals,
             )
-        avals = (state.params, opt_avals, state.params)
-        param_elems = _repl_tree(state.params)
+        if level == "zero3":
+            # zero3's update consumes AND produces chunked params; the
+            # full model never appears in this program at all.
+            param_in_avals = _chunked_opt_avals(state.params, state.params)
+            param_in_elems = _named_tree(
+                mesh,
+                jax.tree.map(lambda _: P("data"), param_in_avals),
+                param_in_avals,
+            )
+            # No params all-gather in the update program (the train step's
+            # gather-on-demand prologue owns it) — wire is the RS alone.
+            declared.comm_variant = "zero3_update"
+            declared.ag_pad_bytes = 0
+        avals = (param_in_avals, opt_avals, state.params)
+        grad_elems = _repl_tree(state.params)
         opt_elems = _named_tree(mesh, opt_spec, opt_avals)
         declared.donated_args = (0, 1)
-        declared.sharding_in = (param_elems, opt_elems, param_elems)
-        declared.sharding_out = (param_elems, opt_elems)
-        # update-only program keeps the dead norm psum only on the
-        # sharded path (train_step._apply_update_sharded)
-        declared.has_dead_norm_psum = bool(arm.shard_update)
+        declared.sharding_in = (param_in_elems, opt_elems, grad_elems)
+        declared.sharding_out = (param_in_elems, opt_elems)
+        # update-only program keeps the dead norm psum only on zero2
+        # (train_step._apply_update_sharded): zero1's optax.global_norm
+        # is collective-free, and make_update_step's zero3 branch goes
+        # straight from scatter to the fenced update — no norm at all.
+        declared.has_dead_norm_psum = level == "zero2"
         return ProgramBundle(name, arm, kind, fn, avals, declared)
 
     # train_step
@@ -628,25 +671,30 @@ def build_program(name: str) -> ProgramBundle:
     labels = jax.ShapeDtypeStruct((A, B, h, w), jnp.int32)
     if arm.spatial:
         fn = make_train_step_gspmd(
-            model, tx, mesh, comp, shard_update=arm.shard_update,
+            model, tx, mesh, comp, shard_update=level,
             seed=cfg.train.seed,
         )
-        if arm.shard_update:
+        if level != "off":
             fn = fn.build_for(state)  # the lowerable inner jit
         state_avals = state
-        opt_layout = "gspmd" if arm.shard_update else None
+        opt_layout = zero.GSPMD_LAYOUT_FOR_LEVEL.get(level)
     else:
         fn = make_train_step(
-            model, tx, mesh, comp, shard_update=arm.shard_update,
-            seed=cfg.train.seed,
+            model, tx, mesh, comp, shard_update=level,
+            seed=cfg.train.seed, param_avals=state.params,
         )
         state_avals = state
         opt_layout = None
-        if arm.shard_update:
+        if level != "off":
             state_avals = state.replace(
                 opt_state=_chunked_opt_avals(state.params, state.opt_state)
             )
-            opt_layout = "zero1"
+            if level == "zero3":
+                # Run-layout params: [N, K] chunks, P('data') on axis 0.
+                state_avals = state_avals.replace(
+                    params=_chunked_opt_avals(state.params, state.params)
+                )
+            opt_layout = level
     declared.donated_args = (0,)
     declared.has_dead_norm_psum = False  # the norm psum is live here
     declared.sharding_in = (
@@ -701,20 +749,40 @@ def _train_state_shard_tree(mesh, arm, tx, state, state_avals, opt_layout):
 
     from ddlpc_tpu.parallel import shard_update as zero
 
+    param_elems = _repl_tree(state_avals.params)
     if opt_layout is None:
         opt_elems = _repl_tree(state_avals.opt_state)
-    elif opt_layout == "zero1":
-        spec = zero.opt_partition_specs(tx, state.params, "zero1", "data")
+    elif opt_layout in zero.CHUNK_LAYOUTS:
+        spec = zero.opt_partition_specs(tx, state.params, opt_layout, "data")
         spec = _respec_chunked(spec, state_avals.opt_state)
         opt_elems = _named_tree(mesh, spec, state_avals.opt_state)
-    else:  # gspmd
+        if opt_layout == "zero3":
+            # state_avals.params are the [N, K] chunk views, P('data').
+            param_elems = _named_tree(
+                mesh,
+                jax.tree.map(lambda _: P("data"), state_avals.params),
+                state_avals.params,
+            )
+    else:  # gspmd family
         spec = zero.opt_partition_specs(
-            tx, state.params, "gspmd", "data", n_shards=mesh.shape["data"]
+            tx, state.params, opt_layout, "data",
+            n_shards=mesh.shape["data"],
         )
         opt_elems = _named_tree(mesh, spec, state_avals.opt_state)
+        if opt_layout == "gspmd_zero3":
+            # Params keep their shapes; the rule engine shards a dim
+            # (even_shard_spec) or keeps the leaf replicated-by-rule.
+            pd = zero.param_decisions(
+                state.params, opt_layout, mesh.shape["data"], "data"
+            )
+            param_elems = _named_tree(
+                mesh,
+                jax.tree.map(lambda d: d.spec, pd),
+                state_avals.params,
+            )
     return state_avals.replace(
         step=_aval_elems(state_avals.step),
-        params=_repl_tree(state_avals.params),
+        params=param_elems,
         batch_stats=_repl_tree(state_avals.batch_stats),
         opt_state=opt_elems,
     )
@@ -898,12 +966,15 @@ def _classify_wire(arm: Arm):
         if base in _WIRE_BASENAMES:
             return "wire"
         if (
-            arm.shard_update
+            arm.shard_update != "off"
             and not arm.spatial
             and op.opcode.startswith("all-gather")
             and base in ("train_step.py", "shard_update.py")
         ):
-            return "wire"  # the ZeRO-1 fresh-params publish
+            # The chunk layouts' params all-gather: zero1/zero2 publish
+            # fresh params at the tail, zero3 gathers on demand at the
+            # step head — wire either way.
+            return "wire"
         return "aux"
 
     return classify
@@ -927,7 +998,9 @@ def check_comm_closed_form(
         n_buckets=d.n_buckets,
     )
     expected: Dict[Tuple[str, str], int] = {}
-    if d.comm_variant in ("allreduce", "scatter"):
+    if d.comm_variant in (
+        "allreduce", "scatter", "zero1", "zero3", "zero3_update"
+    ):
         # The plan's bytes_wire is payload + one fp32 scale per bucket;
         # in the program those are SEPARATE collectives — the narrow
         # payload reduce and the scalar scale pmax(es), the latter
@@ -938,11 +1011,18 @@ def check_comm_closed_form(
         payload = int(row["bytes_wire"]) - scale_in_wire
         if d.comm_variant == "allreduce":
             expected[("all-reduce", wire)] = payload
-        else:
-            expected[("reduce-scatter", wire)] = payload + d.rs_pad_bytes
+        elif d.comm_variant == "zero1":
+            # Full-mean all-reduce + the chunked update's params publish.
+            expected[("all-reduce", wire)] = payload
             expected[("all-gather", "f32")] = (
                 int(plan[1]["bytes_wire"]) + d.ag_pad_bytes
             )
+        else:
+            expected[("reduce-scatter", wire)] = payload + d.rs_pad_bytes
+            if d.comm_variant != "zero3_update":
+                expected[("all-gather", "f32")] = (
+                    int(plan[1]["bytes_wire"]) + d.ag_pad_bytes
+                )
     elif d.comm_variant == "ring":
         expected[("collective-permute", d.wire_dtype)] = plan[0]["bytes_post"]
     scalar_bytes = SCALE_BYTES * d.scale_collectives

@@ -303,21 +303,37 @@ class ParallelConfig:
     data_axis_name: str = "data"
     space_axis_name: str = "space"
     sync_batch_norm: bool = True  # reference lets BN stats drift per replica (SURVEY §3.1)
-    # ZeRO-1 cross-replica sharded optimizer update (docs/SHARDING.md,
-    # arxiv 2004.13336): reduce-scatter the gradient mean, keep the Adam
-    # moments sharded 1/N per replica (never materialized replicated
-    # between steps), update each replica's shard, all-gather the fresh
-    # params.  Same communication volume as the all-reduce it replaces
-    # (all-reduce ≡ reduce-scatter + all-gather); optimizer-state HBM and
-    # update FLOPs divide by the data-axis size.  Bit-identical to the
-    # replicated update for every codec mode (test-pinned); checkpoints
-    # are layout-independent (always stored gathered).
-    # 'auto' (default): on for data meshes > 1, off for singleton meshes
-    # and for the two codec combinations the shard_map path cannot
-    # reproduce bit-identically (transport='ring'; codec_backend='pallas'
-    # with quantize_mean) — explicit 'on' refuses those loudly instead
-    # (parallel/shard_update.py:resolve_shard_update).
-    shard_update: str = "auto"  # auto | on | off
+    # ZeRO cross-replica sharded update ladder (docs/SHARDING.md,
+    # arxiv 2004.13336, 2204.06514).  Levels:
+    # - 'zero1': full-mean all-reduce, then each replica updates only its
+    #   1/N chunk of params+moments and all-gathers the fresh params.
+    #   Optimizer-state HBM and update FLOPs divide by N; wire is 3·P per
+    #   step.  Composes with EVERY codec transport (ring, pallas-mean).
+    #   Trajectories match replicated to within FMA-contraction ulps — a
+    #   declared, test-pinned deviation (parallel/train_step.py:
+    #   _apply_update_zero1), far below any codec's quantization loss.
+    # - 'zero2': the gradient sync IS a reduce-scatter (the fused wire
+    #   already produces shards — zero2 stops all-gathering what it just
+    #   scattered); gradients persist sharded 1/N, wire drops to 2·P.
+    #   Bit-identical to the replicated update for every supported codec
+    #   mode (test-pinned).  This is PR 5's program, renamed: what
+    #   earlier revisions called "zero1" persisted scattered gradient
+    #   shards and is ZeRO-2 in the paper's taxonomy.
+    # - 'zero3': zero2, plus params persist as [N, K] chunks — the step
+    #   all-gathers each leaf on demand for the forward/backward (freed
+    #   after use) and never gathers at step end.  Params+grads+moments
+    #   HBM all divide by N; the per-step params all-gather is the
+    #   honest cost (bench.py --update-ab).  Bit-identical (test-pinned).
+    # - 'auto' (default): 'zero2' for data meshes > 1, 'off' for
+    #   singleton meshes and for the two codec combinations the scatter
+    #   wire cannot reproduce bit-identically (transport='ring';
+    #   codec_backend='pallas' with quantize_mean) — those compose with
+    #   explicit 'zero1' instead.  'on' = 'zero2' but refuses those
+    #   combinations loudly (parallel/shard_update.py:
+    #   resolve_shard_update).  Checkpoints are layout-independent
+    #   (always stored gathered); every layout restores from every
+    #   other's blobs bit-identically.
+    shard_update: str = "auto"  # auto | on | off | zero1 | zero2 | zero3
 
 
 @dataclass(frozen=True)

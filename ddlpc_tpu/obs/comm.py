@@ -103,7 +103,12 @@ def comm_plan(
     """Per-optimizer-step collective rows for one step variant.
 
     ``variant`` ∈ ``allreduce`` (replicated shard_map step),
-    ``scatter`` (ZeRO-1: reduce-scatter grads + all-gather params),
+    ``zero1`` (full-mean all-reduce + params all-gather publish: 3·P),
+    ``scatter`` (ZeRO-2: reduce-scatter grads + all-gather params tail
+    publish: 2·P — the level that stops all-gathering what it just
+    scattered), ``zero3`` (reduce-scatter grads + the gather-on-demand
+    params all-gather at step HEAD: same 2·P volume as scatter, the
+    all-gather just moved from tail publish to forward prologue),
     ``ring`` (compressed ppermute transport), ``gspmd`` (partitioner-
     inserted all-reduce; no per-replica quantize stage exists there, so
     the wire payload is fp32 — train_step.py documents why).
@@ -143,11 +148,37 @@ def comm_plan(
                 "bytes_wire": n_grad_elements * wire_item + scale_bytes,
             }
         ]
-    if variant == "scatter":
+    if variant == "zero1":
+        # Full-mean all-reduce (the codec wire, same as 'allreduce') plus
+        # the chunked update's fresh-params all-gather publish: 3·P.
         wire_mode = mode if (mode != "none" and compression.quantize_local) else "none"
         wire_name, wire_item = simulate_wire_row(compression, axis_size)
         scale_bytes = 0 if wire_name == "f32" else SCALE_BYTES * n_buckets
         return [
+            {
+                "collective": "all_reduce",
+                "codec": wire_mode,
+                "bytes_pre": fp32,
+                "bytes_post": codec_payload_bytes(
+                    n_grad_elements, wire_mode, n_buckets
+                ),
+                "wire_dtype": wire_name,
+                "bytes_wire": n_grad_elements * wire_item + scale_bytes,
+            },
+            {
+                "collective": "all_gather",
+                "codec": "none",
+                "bytes_pre": n_param_elements * 4,
+                "bytes_post": n_param_elements * 4,
+                "wire_dtype": "f32",
+                "bytes_wire": n_param_elements * 4,
+            },
+        ]
+    if variant in ("scatter", "zero3", "zero3_update"):
+        wire_mode = mode if (mode != "none" and compression.quantize_local) else "none"
+        wire_name, wire_item = simulate_wire_row(compression, axis_size)
+        scale_bytes = 0 if wire_name == "f32" else SCALE_BYTES * n_buckets
+        rows = [
             {
                 "collective": "reduce_scatter",
                 "codec": wire_mode,
@@ -158,8 +189,10 @@ def comm_plan(
                 "wire_dtype": wire_name,
                 "bytes_wire": n_grad_elements * wire_item + scale_bytes,
             },
-            # The fresh-params publish of the ZeRO-1 update: uncompressed
-            # by construction (params, not grads).
+            # ZeRO-2: the fresh-params tail publish.  ZeRO-3: the
+            # gather-on-demand at step head (params persist chunked, the
+            # forward gathers them per leaf).  Same volume either way —
+            # uncompressed by construction (params, not grads).
             {
                 "collective": "all_gather",
                 "codec": "none",
@@ -169,6 +202,10 @@ def comm_plan(
                 "bytes_wire": n_param_elements * 4,
             },
         ]
+        # 'zero3_update' is the auditor's update-program slice of zero3:
+        # the step-head params gather belongs to the TRAIN program, so
+        # the bare update moves only the reduce-scatter.
+        return rows[:1] if variant == "zero3_update" else rows
     if variant == "ring":
         if mode == "none":
             # The ring falls back to an exact pmean for mode='none'.

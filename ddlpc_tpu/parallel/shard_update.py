@@ -1,24 +1,46 @@
-"""ZeRO-1 cross-replica sharding of the optimizer update.
+"""ZeRO-1/2/3 cross-replica sharding of gradients, params and optimizer state.
 
 Data-parallel training replicates the optimizer state and redundantly runs
 the identical weight update on every replica — for Adam that is 2× the
 model in fp32 moments per device plus N copies of the same update FLOPs.
 "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
 Training" (arxiv 2004.13336, PAPERS.md) observes the update is elementwise,
-so it can be *sharded*: reduce-scatter the gradients (each replica receives
-the mean of 1/N of the elements), update 1/N of the parameters and moments,
-then all-gather the fresh parameters for the next forward.  Communication
-volume is unchanged (all-reduce ≡ reduce-scatter + all-gather); optimizer
-HBM and update FLOPs divide by N.
+so it can be *sharded*; DeepSpeed named the resulting ladder ZeRO:
 
-This module owns the *layout*: every parameter leaf is flattened, padded to
-a multiple of the data-axis size N, and viewed as ``[N, K]`` chunks — row
-``r`` is replica ``r``'s shard.  Row-major flattening makes the chunk view
-of an already-``[N, K]``-shaped leaf the identity, so the rule "an optimizer
-leaf is chunked iff its unsharded shape equals some parameter's shape"
-(Adam's ``mu``/``nu`` and SGD's ``trace`` mirror the parameter tree;
-``count`` and the schedule scalars do not) is unambiguous.  The arithmetic
-lives in ``grad_sync.sync_gradients_scatter`` and the step builders
+- **zero1** — the moments persist sharded.  The gradient sync stays a
+  full all-reduce (any codec/transport composes, including the ring);
+  each replica then updates only its 1/N chunk and all-gathers the fresh
+  params.  Wire: all-reduce (2·P·w) + params all-gather (P·4).
+- **zero2** — the moments AND the optimizer-boundary gradients persist
+  sharded: the sync is a reduce-scatter (the fused int8/fp16
+  ``psum_scatter`` wire already produces exactly these shards — zero2 is
+  "stop all-gathering what we just scattered"), the update runs on the
+  shards, one all-gather publishes the params.  Wire: reduce-scatter
+  (P·w) + params all-gather (P·4) — strictly LESS than zero1, which is
+  why the update A/B pins zero2 ≤ zero1.  This is the program PR 5
+  introduced (then called "zero1" after the paper's stage-1 HBM effect
+  on the moments; renamed now that the true stage-1 program exists —
+  config values ``auto``/``on`` still resolve here, nothing breaks).
+- **zero3** — params persist sharded too, as the same ``[N, K]`` chunks;
+  each step starts by all-gathering them per leaf on demand for the
+  forward/backward (freed after use — they are temporaries of the step),
+  and the update's fresh chunks are NOT gathered at step end.  Same wire
+  volume as zero2 with the all-gather moved from the tail of step *t* to
+  the head of step *t+1*; per-device persistent HBM for params, grads
+  and moments all scale 1/N.
+
+The *which-leaf-shards* decision is no longer leaf-by-leaf code: the
+declarative rule engine (``parallel/partition.py``,
+``state_partition_rules``) matches ordered regexes against "/"-joined
+leaf names and this module maps the resulting :class:`~partition.Decision`
+trees onto chunk layouts (shard_map path) or GSPMD shardings — the same
+table drives ``StateLayout``, the step builders' specs, the HBM gauges
+and the checkpoint shard/gather fns.
+
+Chunk layout: every sharded leaf is flattened row-major, zero-padded to
+a multiple of the data-axis size N, and viewed as ``[N, K]`` chunks —
+row ``r`` is replica ``r``'s shard.  The arithmetic lives in
+``grad_sync.sync_gradients_scatter`` and the step builders
 (``train_step.py``); checkpoints always store the canonical *gathered*
 layout, so on-disk blobs are layout-independent (docs/SHARDING.md).
 """
@@ -33,8 +55,47 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ddlpc_tpu.config import CompressionConfig
+from ddlpc_tpu.parallel import partition
 
 PyTree = Any
+
+# The shard_map chunk layouts, the GSPMD leaf layouts, and both
+# families' logical ZeRO level (which state_partition_rules table they
+# read).
+CHUNK_LAYOUTS = ("zero1", "zero2", "zero3")
+GSPMD_LAYOUTS = ("gspmd", "gspmd_zero2", "gspmd_zero3")
+LAYOUT_LEVEL = {
+    "replicated": "replicated",
+    "zero1": "zero1",
+    "zero2": "zero2",
+    "zero3": "zero3",
+    "gspmd": "zero1",
+    "gspmd_zero2": "zero2",
+    "gspmd_zero3": "zero3",
+}
+# Level → the GSPMD-family layout with the same persisted-state sharding
+# (the Trainer's mode pick on data×space meshes; inverse of LAYOUT_LEVEL
+# restricted to the GSPMD family).
+GSPMD_LAYOUT_FOR_LEVEL = {
+    "zero1": "gspmd",
+    "zero2": "gspmd_zero2",
+    "zero3": "gspmd_zero3",
+}
+
+
+def normalize_shard_update(value) -> str:
+    """Step builders accept the historical bool (``True`` = the sharded
+    program, which is zero2) or a level string — one knob, one meaning."""
+    if value is True:
+        return "zero2"
+    if value is False or value is None or value == "off":
+        return "off"
+    if value in CHUNK_LAYOUTS:
+        return value
+    raise ValueError(
+        f"unknown shard_update level {value!r} "
+        f"(expected off|zero1|zero2|zero3 or a bool)"
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -75,7 +136,7 @@ def local_chunk(x: jax.Array, n_shards: int, axis_name: str) -> jax.Array:
 
 
 # ---------------------------------------------------------------------------
-# which optimizer-state leaves are sharded
+# which state leaves are sharded
 
 
 def param_shapes(params: PyTree) -> frozenset:
@@ -83,7 +144,7 @@ def param_shapes(params: PyTree) -> frozenset:
 
 
 def validate_zero1_params(params: PyTree) -> None:
-    """Refuse 0-d parameters in the zero1 layout, loudly: the chunk rule
+    """Refuse 0-d parameters in the chunk layouts, loudly: the chunk rule
     identifies an optimizer leaf as a moment by its parameter shape, and
     ``chunkable`` excludes ``()`` precisely because Adam's ``count`` and
     schedule scalars are also ``()`` — a 0-d *parameter* would make its
@@ -98,7 +159,7 @@ def validate_zero1_params(params: PyTree) -> None:
     ]
     if bad:
         raise ValueError(
-            f"shard_update (zero1 layout) cannot represent 0-d parameters "
+            f"shard_update (chunk layouts) cannot represent 0-d parameters "
             f"{bad} — reshape them to (1,) or set shard_update='off' "
             f"(parallel/shard_update.py:validate_zero1_params)"
         )
@@ -107,7 +168,9 @@ def validate_zero1_params(params: PyTree) -> None:
 def chunkable(shape: Tuple[int, ...], pshapes: frozenset) -> bool:
     """A (full-layout) optimizer leaf is sharded iff it is parameter-shaped:
     Adam/SGD moments mirror the parameter tree leaf-for-leaf; step counters
-    and schedule scalars are not parameter-shaped and stay replicated."""
+    and schedule scalars are not parameter-shaped and stay replicated.
+    (The rule engine's name match is the intent; this shape check remains
+    the safety gate — ``partition.decide``'s ``param_shaped``.)"""
     return len(shape) > 0 and tuple(shape) in pshapes
 
 
@@ -131,74 +194,120 @@ def resolve_shard_update(
     data_size: int,
     spatial: bool,
     grad_clip_norm: float = 0.0,
-) -> bool:
-    """Resolve ``ParallelConfig.shard_update`` ∈ {auto, on, off} to a bool.
+) -> str:
+    """Resolve ``ParallelConfig.shard_update`` to a ZeRO level string
+    (``'off' | 'zero1' | 'zero2' | 'zero3'``).
 
-    ``auto`` (the default) turns sharding on for data meshes > 1 and off
-    for singleton meshes and for the three combinations the shard_map
-    path cannot reproduce bit-identically (explicit ``on`` refuses those
-    loudly instead of silently changing semantics):
+    ``auto`` (the default) and ``on`` resolve to **zero2** — the program
+    this repo has shipped since PR 5 (then labelled zero1; see the module
+    docstring), so existing configs keep their exact step program.
+    ``auto`` falls back to ``off`` on singleton meshes and on the
+    combinations the scatter path cannot reproduce bit-identically;
+    explicit levels (and ``on``) refuse those loudly instead of silently
+    changing semantics:
 
-    - ``transport='ring'``: the ring owns its own full-tree quantized
-      reduce-scatter/all-gather (compressed_allreduce.py) whose integer
-      wire sums are defined over whole leaves — splitting the mean stage
-      across replicas would change which elements share a wire word.
-    - ``codec_backend='pallas'`` with ``quantize_mean``: the kernel draws
-      its rounding noise from the TPU hardware PRNG per block, which
-      cannot be sliced to a replica's shard of the mean; the XLA backend's
-      threefry field can (grad_sync.sync_gradients_scatter).
-    - ``grad_clip_norm > 0``: ``optax.clip_by_global_norm`` runs *inside*
-      ``tx.update``, which the chunked path calls on each replica's 1/N
-      shard — every replica would clip by the norm of its own shard
-      instead of the global norm (wrong threshold, replica-divergent
-      updates).  The clip stage cannot see the cross-replica sum from
-      inside an opaque optax chain.
+    - ``transport='ring'`` (zero2/zero3 only): the ring owns its own
+      quantized reduce-scatter/all-gather over whole leaves
+      (compressed_allreduce.py) — splitting the mean stage across
+      replicas would change which elements share a wire word.  **zero1
+      composes**: its sync is the unmodified full all-reduce, the ring
+      included; the chunking happens after the mean exists everywhere.
+    - ``codec_backend='pallas'`` with ``quantize_mean`` (zero2/zero3
+      only): the kernel draws its rounding noise from the TPU hardware
+      PRNG per block, which cannot be sliced to a replica's shard of the
+      mean.  **zero1 composes** for the same reason as the ring: the
+      codec sees the full mean.
+    - ``grad_clip_norm > 0`` (every chunked level): ``optax.
+      clip_by_global_norm`` runs *inside* ``tx.update``, which all three
+      chunk layouts call on each replica's 1/N shard — every replica
+      would clip by its own shard's norm instead of the global norm
+      (wrong threshold, replica-divergent updates).
 
     The GSPMD (spatial) path has none of these constraints: its codec and
     optimizer run on the full logical arrays inside the partitioned
     program (``optax.global_norm`` there is a partitioner-inserted psum),
-    so only the mesh size gates.
+    so only the mesh size gates.  The Trainer maps the returned level to
+    the GSPMD layout family (``StateLayout``) on data×space meshes.
     """
-    if mode not in ("auto", "on", "off"):
+    if mode not in ("auto", "on", "off", "zero1", "zero2", "zero3"):
         raise ValueError(
-            f"unknown shard_update {mode!r} (expected 'auto', 'on' or 'off')"
+            f"unknown shard_update {mode!r} (expected 'auto', 'on', 'off', "
+            f"'zero1', 'zero2' or 'zero3')"
         )
     if mode == "off":
-        return False
+        return "off"
+    level = "zero2" if mode in ("auto", "on") else mode
     incompatible = None
-    if not spatial and compression.mode != "none":
-        if compression.transport == "ring":
+    if not spatial:
+        scatter_based = level in ("zero2", "zero3")
+        if scatter_based and compression.mode != "none":
+            if compression.transport == "ring":
+                incompatible = (
+                    "transport='ring' — the ring all-reduce owns its own "
+                    "quantized reduce-scatter/all-gather over whole leaves "
+                    "(shard_update='zero1' composes with the ring)"
+                )
+            elif (
+                compression.quantize_mean
+                and compression.codec_backend == "pallas"
+            ):
+                incompatible = (
+                    "codec_backend='pallas' with quantize_mean — the "
+                    "kernel's hardware-PRNG noise field cannot be sliced to "
+                    "a shard of the mean; use codec_backend='xla' or "
+                    "shard_update='zero1'"
+                )
+        if incompatible is None and grad_clip_norm:
             incompatible = (
-                "transport='ring' — the ring all-reduce owns its own "
-                "quantized reduce-scatter/all-gather over whole leaves"
+                "grad_clip_norm > 0 — optax.clip_by_global_norm inside "
+                "tx.update would clip each replica's 1/N shard by its own "
+                "partial norm, not the global norm; use a data×space mesh "
+                "(GSPMD path) or disable clipping"
             )
-        elif compression.quantize_mean and compression.codec_backend == "pallas":
-            incompatible = (
-                "codec_backend='pallas' with quantize_mean — the kernel's "
-                "hardware-PRNG noise field cannot be sliced to a shard of "
-                "the mean; use codec_backend='xla'"
-            )
-    if not spatial and incompatible is None and grad_clip_norm:
-        incompatible = (
-            "grad_clip_norm > 0 — optax.clip_by_global_norm inside "
-            "tx.update would clip each replica's 1/N shard by its own "
-            "partial norm, not the global norm; use a data×space mesh "
-            "(GSPMD path) or disable clipping"
-        )
-    if mode == "on":
+    if mode != "auto":
         if incompatible:
             raise ValueError(
-                f"shard_update='on' cannot compose with {incompatible}; set "
-                f"shard_update='off' (or 'auto', which resolves it)"
+                f"shard_update={mode!r} cannot compose with {incompatible}; "
+                f"set shard_update='off' (or 'auto', which resolves it)"
             )
         # Singleton mesh: sharding into 1 shard is the replicated program —
         # fall back to it rather than carry a degenerate chunk layout.
-        return data_size > 1
-    return data_size > 1 and incompatible is None
+        return level if data_size > 1 else "off"
+    return level if data_size > 1 and incompatible is None else "off"
 
 
 # ---------------------------------------------------------------------------
-# state layout: replicated | zero1 (chunked, shard_map) | gspmd (leaf-sharded)
+# rule-engine decision trees over the state
+
+
+def opt_decisions(
+    tx, params: PyTree, layout: str, n_shards: int, data_axis: str = "data"
+) -> PyTree:
+    """Partition decisions for the full-layout opt_state template under
+    ``layout`` — the rule table (``partition.state_partition_rules``) is
+    the intent, the parameter-shape set the safety gate."""
+    template = opt_state_template(tx, params)
+    mode = "chunk" if layout in CHUNK_LAYOUTS else "leaf"
+    return partition.decide_tree(
+        partition.state_partition_rules(LAYOUT_LEVEL[layout]),
+        template, "opt_state",
+        mode=mode, n_shards=n_shards, data_axis=data_axis,
+        pshapes=param_shapes(params),
+    )
+
+
+def param_decisions(
+    params: PyTree, layout: str, n_shards: int, data_axis: str = "data",
+    prefix: str = "params",
+) -> PyTree:
+    """Partition decisions for the params (or, with ``prefix='grads'``,
+    the optimizer-boundary gradient) tree under ``layout``."""
+    mode = "chunk" if layout in CHUNK_LAYOUTS else "leaf"
+    return partition.decide_tree(
+        partition.state_partition_rules(LAYOUT_LEVEL[layout]),
+        params, prefix,
+        mode=mode, n_shards=n_shards, data_axis=data_axis,
+    )
 
 
 def opt_leaf_spec(
@@ -209,15 +318,14 @@ def opt_leaf_spec(
     data_axis: str,
 ) -> Optional[P]:
     """Run-layout partition spec for ONE full-layout optimizer leaf — the
-    single owner of the which-leaves-shard-and-how decision, shared by
-    every site that builds opt-state specs (``StateLayout``, both step
-    builders, ``make_update_step``) so the trainer's placement and the
-    steps' in/out specs cannot drift apart.  Returns ``None`` for leaves
-    that are not parameter-shaped (step counters, schedule scalars): they
-    stay replicated and get no sharding constraint."""
+    per-leaf form of the rule engine's decision, kept for callers that
+    iterate leaves themselves (the GSPMD builder's constraint loop).
+    Returns ``None`` for leaves that are not parameter-shaped (step
+    counters, schedule scalars): they stay replicated and get no
+    sharding constraint."""
     if not chunkable(shape, pshapes):
         return None
-    if layout == "zero1":
+    if layout in CHUNK_LAYOUTS:
         return P(data_axis)
     return zero_leaf_spec(shape, n_shards, data_axis)
 
@@ -226,37 +334,20 @@ def opt_partition_specs(
     tx, params: PyTree, layout: str, data_axis: str, n_shards: int = 1
 ) -> PyTree:
     """PartitionSpec tree over the full-layout opt_state template for the
-    run ``layout`` (shard_map in_specs/out_specs form; non-param-shaped
-    leaves → ``P()``).  ``n_shards`` only matters for ``layout='gspmd'``."""
-    if layout == "zero1":
+    run ``layout`` (shard_map in_specs/out_specs form; non-sharded leaves
+    → ``P()``).  ``n_shards`` only matters for the GSPMD layouts."""
+    if layout in CHUNK_LAYOUTS:
         validate_zero1_params(params)
-    template = opt_state_template(tx, params)
-    pshapes = param_shapes(params)
-
-    def leaf(t):
-        sp = opt_leaf_spec(t.shape, pshapes, layout, n_shards, data_axis)
-        return P() if sp is None else sp
-
-    return jax.tree.map(leaf, template)
+    decisions = opt_decisions(tx, params, layout, n_shards, data_axis)
+    return jax.tree.map(lambda d: d.spec, decisions)
 
 
-def _map_opt_shardings(
-    template: PyTree, pshapes: frozenset, layout: str, mesh: Mesh,
-    data_axis: str,
-) -> PyTree:
-    """Map :func:`opt_leaf_spec` over a full-layout opt_state template as a
-    NamedSharding tree — the one implementation behind both the function
-    and :class:`StateLayout` forms, so they cannot drift."""
+def _decision_shardings(decisions: PyTree, mesh: Mesh) -> PyTree:
     repl = NamedSharding(mesh, P())
-    if layout == "replicated":
-        return jax.tree.map(lambda t: repl, template)
-    n = mesh.shape[data_axis]
-
-    def leaf(t):
-        sp = opt_leaf_spec(t.shape, pshapes, layout, n, data_axis)
-        return repl if sp is None else NamedSharding(mesh, sp)
-
-    return jax.tree.map(leaf, template)
+    return jax.tree.map(
+        lambda d: repl if not d.sharded else NamedSharding(mesh, d.spec),
+        decisions,
+    )
 
 
 def opt_shardings(
@@ -265,36 +356,26 @@ def opt_shardings(
     """NamedSharding tree (jit in_shardings / device_put form) for the run
     ``layout`` of the optimizer state — same decisions as
     :func:`opt_partition_specs`, mesh-attached."""
-    return _map_opt_shardings(
-        opt_state_template(tx, params), param_shapes(params), layout, mesh,
-        data_axis,
+    if layout == "replicated":
+        template = opt_state_template(tx, params)
+        repl = NamedSharding(mesh, P())
+        return jax.tree.map(lambda t: repl, template)
+    return _decision_shardings(
+        opt_decisions(tx, params, layout, mesh.shape[data_axis], data_axis),
+        mesh,
     )
 
 
 def zero_leaf_spec(
     shape: Tuple[int, ...], n_shards: int, data_axis: str
 ) -> P:
-    """GSPMD ZeRO spec for a param-shaped optimizer leaf: partition the
-    largest dimension that divides EVENLY by the data axis; leaves with
-    no such dimension stay replicated.  (An uneven pick used to fall
-    back to the largest dimension ≥ N on the theory that GSPMD pads —
-    but an uneven NamedSharding is rejected by ``jit in_shardings`` at
-    the state boundary, so any model with e.g. a 6-class bias on a 4-way
-    mesh crashed at placement.  Surfaced by the compiled-program auditor,
-    docs/ANALYSIS.md "Program-level contracts"; such leaves are a
-    rounding error of the moment bytes, so replicating them costs ~0.)"""
-    if not shape:
-        return P()
-    pick = None
-    for d in sorted(range(len(shape)), key=lambda d: shape[d], reverse=True):
-        if shape[d] >= n_shards and shape[d] % n_shards == 0:
-            pick = d
-            break
-    if pick is None:
-        return P()
-    spec = [None] * len(shape)
-    spec[pick] = data_axis
-    return P(*spec)
+    """GSPMD ZeRO spec for a param-shaped leaf: partition the largest
+    evenly-divisible dimension; no such dimension → replicated.  The
+    pick itself lives in the rule engine (:func:`partition.even_shard_spec`
+    — the same resolver every SHARD rule uses), and leaves it replicates
+    carry the explicit ``replicated-by-rule`` decision the sharding
+    contract and HBM gauges budget."""
+    return partition.even_shard_spec(shape, n_shards, data_axis)
 
 
 class StateLayout:
@@ -302,21 +383,38 @@ class StateLayout:
     (what checkpoints store, what ``create_train_state`` builds) and the
     run layout the train step consumes.
 
-    - ``mode='replicated'``: run layout == canonical layout.
-    - ``mode='zero1'`` (shard_map step): opt-state moments live as
-      ``[N, K]`` chunk leaves sharded ``P(data)`` over the mesh — each
-      device holds one ``[1, K]`` row; params stay replicated (the forward
-      needs them whole).
-    - ``mode='gspmd'``: opt-state moments keep their parameter shapes but
-      are partitioned ``P(..., data, ...)`` per :func:`zero_leaf_spec`; the
-      XLA partitioner inserts the reduce-scatter/all-gather around the
-      update on its own.
+    shard_map (chunk) family — sharded leaves live as ``[N, K]`` chunk
+    views sharded ``P(data)``, each device holding one ``[1, K]`` row:
+
+    - ``mode='zero1'`` / ``'zero2'``: the optimizer moments chunk; params
+      stay replicated (the forward needs them whole).  The two levels
+      place identically — they differ only in the step program's wire
+      (zero1 all-reduces the mean then slices; zero2 keeps the
+      reduce-scattered shards).
+    - ``mode='zero3'``: params chunk too; the step all-gathers them on
+      demand (train_step.py) and checkpoints/eval gather via
+      :meth:`full_params`.
+
+    GSPMD (leaf) family — sharded leaves keep their parameter shapes but
+    carry ``P(..., data, ...)`` shardings (``partition.even_shard_spec``
+    picks the dimension); the XLA partitioner inserts the collectives:
+
+    - ``mode='gspmd'``: moments sharded (the PR 5 behavior).
+    - ``mode='gspmd_zero2'``: same placement; the step additionally pins
+      the mean gradient's shardings so the partitioner materializes a
+      reduce-scatter instead of an all-reduce.
+    - ``mode='gspmd_zero3'``: params sharded at the state boundary too.
 
     ``place``/``canonical`` are jitted once and cached — at checkpoint
     cadence a retrace per save would otherwise recompile the gather every
     epoch.  Both are collectives under multi-host meshes, so every process
-    must call them (Trainer.save/restore do).
+    must call them (Trainer.save/restore do).  The per-leaf chunk/unchunk
+    callables come from ``partition.make_shard_and_gather_fns`` over the
+    same decision trees that build the sharding specs — one table, no
+    drift.
     """
+
+    MODES = ("replicated",) + CHUNK_LAYOUTS + GSPMD_LAYOUTS
 
     def __init__(
         self,
@@ -326,7 +424,7 @@ class StateLayout:
         mesh: Mesh,
         data_axis: str = "data",
     ):
-        if mode not in ("replicated", "zero1", "gspmd"):
+        if mode not in self.MODES:
             raise ValueError(f"unknown state layout {mode!r}")
         self.mesh = mesh
         self.data_axis = data_axis
@@ -334,30 +432,84 @@ class StateLayout:
         # Singleton data mesh: one shard IS the replicated layout — mirror
         # the step builders' fallback so layout and step cannot disagree.
         self.mode = mode if self.n > 1 else "replicated"
-        if self.mode == "zero1":
+        self.level = LAYOUT_LEVEL[self.mode]
+        self.chunk = self.mode in CHUNK_LAYOUTS
+        self.chunk_params = self.mode == "zero3"
+        self.sharded_params = self.mode in ("zero3", "gspmd_zero3")
+        if self.chunk:
             validate_zero1_params(state.params)
         self._repl = NamedSharding(mesh, P())
         self._template = opt_state_template(tx, state.params)
         self._pshapes = param_shapes(state.params)
+        self.param_avals = jax.tree.map(
+            lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), state.params
+        )
+        pmode = "chunk" if self.chunk or self.mode == "replicated" else "leaf"
+        rules = partition.state_partition_rules(self.level)
+        self.opt_decisions = partition.decide_tree(
+            rules, self._template, "opt_state",
+            mode=pmode, n_shards=self.n, data_axis=data_axis,
+            pshapes=self._pshapes,
+        )
+        self.param_decisions = partition.decide_tree(
+            rules, state.params, "params",
+            mode=pmode, n_shards=self.n, data_axis=data_axis,
+        )
+        self.grad_decisions = partition.decide_tree(
+            rules, state.params, "grads",
+            mode=pmode, n_shards=self.n, data_axis=data_axis,
+        )
+        self._opt_shard_fns, self._opt_gather_fns = (
+            partition.make_shard_and_gather_fns(
+                self.opt_decisions, self.n, pmode
+            )
+        )
+        self._param_shard_fns, self._param_gather_fns = (
+            partition.make_shard_and_gather_fns(
+                self.param_decisions, self.n, pmode
+            )
+        )
         self._place_fn = None
         self._canonical_fn = None
+        self._full_params_fn = None
 
     # -- sharding trees -----------------------------------------------------
 
+    def _chunk_aware_sharding(self, decision) -> NamedSharding:
+        """Chunk-mode sharded leaves change shape ([N, K]); their spec
+        P(data) applies to the chunk view — NamedSharding is shape-blind,
+        so the same object covers both families."""
+        if not decision.sharded:
+            return self._repl
+        return NamedSharding(self.mesh, decision.spec)
+
     def _opt_shardings(self) -> PyTree:
-        return _map_opt_shardings(
-            self._template, self._pshapes, self.mode, self.mesh,
-            self.data_axis,
-        )
+        return jax.tree.map(self._chunk_aware_sharding, self.opt_decisions)
+
+    def _param_shardings(self) -> PyTree:
+        if not self.sharded_params:
+            return jax.tree.map(lambda _: self._repl, self.param_decisions)
+        return jax.tree.map(self._chunk_aware_sharding, self.param_decisions)
 
     def state_shardings(self, state: PyTree) -> PyTree:
         """Per-leaf NamedSharding tree for the RUN layout of ``state``."""
         return state.replace(
             step=self._repl,
-            params=jax.tree.map(lambda _: self._repl, state.params),
+            params=self._param_shardings(),
             batch_stats=jax.tree.map(lambda _: self._repl, state.batch_stats),
             opt_state=self._opt_shardings(),
         )
+
+    def replicated_by_rule_bytes(self) -> int:
+        """Per-device bytes of leaves the rule engine decided to keep
+        replicated (uneven GSPMD dims) — the ``ddlpc_hbm`` budget line."""
+        total = 0
+        for dec, tree in (
+            (self.opt_decisions, self._template),
+            (self.param_decisions, self.param_avals),
+        ):
+            total += partition.replicated_by_rule_bytes(dec, tree)
+        return total
 
     # -- layout conversion --------------------------------------------------
 
@@ -367,23 +519,15 @@ class StateLayout:
             return jax.device_put(state, self._repl)
         if self._place_fn is None:
             shardings = self.state_shardings(state)
-            if self.mode == "zero1":
-                n = self.n
 
-                def to_run(s):
-                    opt = jax.tree.map(
-                        lambda t, l: chunk_leaf(l, n)
-                        if chunkable(t.shape, self._pshapes)
-                        else l,
-                        self._template,
-                        s.opt_state,
-                    )
-                    return s.replace(opt_state=opt)
-
-            else:  # gspmd: same shapes, different placement
-
-                def to_run(s):
-                    return s
+            def to_run(s):
+                opt = jax.tree.map(
+                    lambda f, l: f(l), self._opt_shard_fns, s.opt_state
+                )
+                params = jax.tree.map(
+                    lambda f, l: f(l), self._param_shard_fns, s.params
+                )
+                return s.replace(params=params, opt_state=opt)
 
             self._place_fn = jax.jit(to_run, out_shardings=shardings)
         return self._place_fn(state)
@@ -391,26 +535,39 @@ class StateLayout:
     def canonical(self, state: PyTree) -> PyTree:
         """Run layout → canonical full replicated layout (the checkpoint/
         broadcast layout).  For sharded modes this compiles to an
-        all-gather of the moments — transiently materializing the full
-        optimizer state once per checkpoint, never per step."""
+        all-gather of the sharded leaves — transiently materializing the
+        full state once per checkpoint, never per step."""
         if self.mode == "replicated":
             return state
         if self._canonical_fn is None:
-            if self.mode == "zero1":
-                def to_full(s):
-                    opt = jax.tree.map(
-                        lambda t, l: unchunk_leaf(l, t.shape)
-                        if chunkable(t.shape, self._pshapes)
-                        else l,
-                        self._template,
-                        s.opt_state,
-                    )
-                    return s.replace(opt_state=opt)
 
-            else:
-
-                def to_full(s):
-                    return s
+            def to_full(s):
+                opt = jax.tree.map(
+                    lambda f, l: f(l), self._opt_gather_fns, s.opt_state
+                )
+                params = jax.tree.map(
+                    lambda f, l: f(l), self._param_gather_fns, s.params
+                )
+                return s.replace(params=params, opt_state=opt)
 
             self._canonical_fn = jax.jit(to_full, out_shardings=self._repl)
         return self._canonical_fn(state)
+
+    def full_params(self, state: PyTree) -> PyTree:
+        """Canonical-shape replicated params from the run layout — what
+        eval/predict/serve consume.  Identity for layouts that keep
+        params whole; a compiled all-gather (chunked or GSPMD-sharded →
+        replicated) under zero3/gspmd_zero3.  Gathers ONLY the params,
+        not the moments — eval must not pay the checkpoint gather."""
+        if not self.sharded_params:
+            return state.params
+        if self._full_params_fn is None:
+            repl = jax.tree.map(lambda _: self._repl, self.param_avals)
+
+            def gather(params):
+                return jax.tree.map(
+                    lambda f, l: f(l), self._param_gather_fns, params
+                )
+
+            self._full_params_fn = jax.jit(gather, out_shardings=repl)
+        return self._full_params_fn(state.params)

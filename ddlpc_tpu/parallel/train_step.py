@@ -252,12 +252,17 @@ def _apply_update_sharded(
     compression: CompressionConfig,
     key,
 ):
-    """The ZeRO-1 weight-update path, called inside shard_map with LOCAL
+    """The ZeRO-2 weight-update path, called inside shard_map with LOCAL
     values: full per-replica ``grads``/``params``, this replica's ``[1, K]``
-    chunks of the optimizer moments in ``opt_state``.  Returns the fresh
-    full params (all-gathered), the updated local moment chunks, and the
-    psum'd grad norm of the post-codec mean.  Shared by the train step and
-    the update-only bench program so their semantics cannot diverge."""
+    chunks of the optimizer moments in ``opt_state``.  The gradient sync
+    is a reduce-scatter — the optimizer-boundary gradient only ever
+    materializes as this replica's shard (1/N of the tree per device),
+    which is what makes this zero2: zero1's full-mean all-reduce IS this
+    reduce-scatter plus an all-gather of gradients nobody needs
+    (``_apply_update_zero1``).  Returns the fresh full params
+    (all-gathered), the updated local moment chunks, and the psum'd grad
+    norm of the post-codec mean.  Shared by the train step and the
+    update-only bench program so their semantics cannot diverge."""
     grad_shards = sync_gradients_scatter(
         grads, data_axis, compression, axis_size=axis_size, key=key
     )
@@ -277,17 +282,86 @@ def _apply_update_sharded(
     return new_params, new_opt, _psum_sq_norm(grad_shards, data_axis)
 
 
-def _zero1_state_specs(
-    state: TrainState, tx: optax.GradientTransformation, data_axis: str
+def _apply_update_zero1(
+    tx: optax.GradientTransformation,
+    params: PyTree,
+    opt_state: PyTree,
+    grads: PyTree,
+    data_axis: str,
+    axis_size: int,
+    compression: CompressionConfig,
+    key,
+):
+    """The TRUE ZeRO-1 weight-update path (sharded moments, full-mean
+    gradient sync): the all-reduce is the unmodified ``sync_gradients``
+    — every codec and transport composes, the ring and the pallas mean
+    stage included, because the codec sees the whole mean — then each
+    replica slices its ``[1, K]`` row of the mean and of the params,
+    runs the fenced update on the chunks, and all-gathers fresh params.
+
+    DECLARED DEVIATION (test-pinned): zero1 trajectories match
+    replicated/zero2 to within FMA-contraction ulps, not byte-for-byte.
+    The update's *inputs* are bit-identical — the sliced mean equals the
+    scatter path's shards element-for-element (``psum`` ≡ ``psum_scatter``
+    per element is test-pinned, and the scatter codec quantizes shards
+    with the global scale and the sliced full-shape noise field precisely
+    so its shards equal slices of the full quantized mean; both pins in
+    tests/test_shard_update.py) — but the chunk *slice* feeds the update
+    through fusable ops, the backend fuses it into the Adam kernel
+    (``lax.optimization_barrier`` does not block loop fusion on the CPU
+    backend — verified in the optimized HLO), and LLVM then contracts
+    mul+add into FMA differently than in the replicated/zero2 kernels,
+    whose update inputs are jit-boundary or collective outputs: ≤1-ulp
+    drift per step on small leaves.  zero2/zero3 keep the byte-for-byte
+    bar; zero1 exists for the combinations the scatter path refuses
+    (``resolve_shard_update``: ring transport, pallas mean stage — codecs
+    whose *declared* loss dwarfs an update ulp) and as the honest A/B
+    baseline for the zero2-≤-zero1 perf claim (``bench.py --update-ab``).
+    Wire: zero1 moves 3·P elements per step (2·P all-reduce + P params
+    all-gather) where zero2 moves 2·P — zero2 literally stops
+    all-gathering what the reduce-scatter just produced."""
+    grads = sync_gradients(
+        grads, data_axis, compression, axis_size=axis_size, key=key
+    )
+    grad_norm = optax.global_norm(grads)
+    grad_shards = jax.tree.map(
+        lambda g: zero.local_chunk(g, axis_size, data_axis), grads
+    )
+    param_shards = jax.tree.map(
+        lambda p: zero.local_chunk(p, axis_size, data_axis), params
+    )
+    new_param_shards, new_opt = _fenced_update(
+        tx, grad_shards, opt_state, param_shards
+    )
+    new_params = jax.tree.map(
+        lambda sh, p: zero.unchunk_leaf(
+            lax.all_gather(sh, data_axis, axis=0, tiled=True), p.shape
+        ),
+        new_param_shards,
+        params,
+    )
+    return new_params, new_opt, grad_norm
+
+
+def _zero_state_specs(
+    state: TrainState,
+    tx: optax.GradientTransformation,
+    data_axis: str,
+    level: str,
 ) -> TrainState:
-    """shard_map partition specs for the ZeRO-1 run layout: params/stats/
-    step replicated, chunked opt-state moments split over ``data_axis``.
-    Built at trace time from the state's avals (the chunk-vs-scalar
-    decision needs the abstract full-layout opt_state, shard_update.py)."""
-    opt_specs = zero.opt_partition_specs(tx, state.params, "zero1", data_axis)
+    """shard_map partition specs for the chunked run layouts: stats/step
+    replicated, chunked opt-state moments split over ``data_axis``;
+    params replicated for zero1/zero2 and chunked (``P(data)`` on the
+    ``[N, K]`` view) for zero3.  Built at trace time from the state's
+    avals via the partition-rule tables (shard_update.py) — for zero3
+    the state's params are already chunk-shaped, which the name-matched
+    rules place identically (the opt template derived from chunked
+    params has the same treedef and moment names)."""
+    opt_specs = zero.opt_partition_specs(tx, state.params, level, data_axis)
+    param_spec = P(data_axis) if level == "zero3" else P()
     return state.replace(
         step=P(),
-        params=jax.tree.map(lambda _: P(), state.params),
+        params=jax.tree.map(lambda _: param_spec, state.params),
         batch_stats=jax.tree.map(lambda _: P(), state.batch_stats),
         opt_state=opt_specs,
     )
@@ -303,6 +377,7 @@ def make_train_step(
     remat: bool = False,
     seed: int = 0,
     shard_update: bool = False,
+    param_avals: Optional[PyTree] = None,
 ) -> Callable[[TrainState, jax.Array, jax.Array], Tuple[TrainState, dict]]:
     """Build the jitted SPMD train step.
 
@@ -313,18 +388,32 @@ def make_train_step(
     sharded over the data axis.
     Returns (new_state, metrics) with metrics averaged over A and the mesh.
 
-    ``shard_update=True`` selects the ZeRO-1 sharded weight update
-    (shard_update.py, docs/SHARDING.md): the gradient pmean becomes a
-    reduce-scatter, each replica updates its 1/N chunk of params/moments,
-    and an all-gather publishes the fresh params — the state's opt_state
-    must be in the chunked run layout (``shard_update.StateLayout``).
-    Bit-identical to the replicated update for every supported codec mode
-    (test-pinned); on a singleton data mesh it falls back to the
-    replicated program (sharding into one shard IS replication).
+    ``shard_update`` selects the ZeRO level of the weight update
+    (shard_update.py, docs/SHARDING.md; the historical bool maps
+    ``True`` → ``'zero2'``, the program this repo has always called the
+    sharded update):
+
+    - ``'zero1'``: full-mean all-reduce, then each replica updates its
+      1/N chunk of params + moments and all-gathers the params.
+    - ``'zero2'``: the gradient sync IS a reduce-scatter; the update
+      runs on the shards; one all-gather publishes the params.
+    - ``'zero3'``: params also persist as ``[N, K]`` chunks; the step
+      starts by all-gathering them per leaf for the forward/backward
+      (they are step temporaries, freed after use) and the fresh chunks
+      are NOT gathered at step end.  Requires ``param_avals`` — the
+      canonical parameter shapes the chunks restore to.
+
+    The state must be in the matching run layout
+    (``shard_update.StateLayout``).  zero2 and zero3 are bit-identical
+    to the replicated update for every supported codec mode
+    (test-pinned); zero1 matches to within FMA-contraction ulps — a
+    declared, test-pinned deviation (see ``_apply_update_zero1``).  On a
+    singleton data mesh all levels fall back to the replicated program
+    (sharding into one shard IS replication).
 
     Precondition on ``tx`` (uncheckable — optax chains are opaque): no
     stage may couple elements across the tree, e.g. ``clip_by_global_norm``
-    — under the sharded update each replica's ``tx.update`` sees only its
+    — under every chunked level each replica's ``tx.update`` sees only its
     1/N chunk, so a global-norm clip would use the shard's partial norm
     (wrong threshold, replica-divergent params).  The config path enforces
     this via ``resolve_shard_update(grad_clip_norm=...)``; direct callers
@@ -338,16 +427,41 @@ def make_train_step(
                 f"data×space meshes (the Trainer selects it automatically)"
             )
     axis_size = mesh.shape[data_axis]
-    shard_update = shard_update and axis_size > 1
-    if shard_update:
+    level = zero.normalize_shard_update(shard_update)
+    if axis_size <= 1:
+        level = "off"
+    if level in ("zero2", "zero3"):
         from ddlpc_tpu.parallel.grad_sync import validate_scatter_compression
 
         validate_scatter_compression(compression)
+    if level == "zero3" and param_avals is None:
+        raise ValueError(
+            "make_train_step(shard_update='zero3') requires param_avals — "
+            "the canonical parameter shapes the chunked leaves restore to "
+            "(StateLayout.param_avals)"
+        )
 
     def shard_body(state: TrainState, images: jax.Array, labels: jax.Array):
         # Inside shard_map: images [A, B_local, H, W, C].
+        if level == "zero3":
+            # Gather-on-demand: the persisted params are this replica's
+            # [1, K] chunks; all-gather each leaf back to its canonical
+            # shape for the forward/backward.  The gathered tree is a
+            # step temporary — XLA frees it after the backward — so the
+            # full model never persists in HBM between steps.
+            full_params = jax.tree.map(
+                lambda ch, av: zero.unchunk_leaf(
+                    lax.all_gather(ch, data_axis, axis=0, tiled=True),
+                    av.shape,
+                ),
+                state.params,
+                param_avals,
+            )
+            fwd_state = state.replace(params=full_params)
+        else:
+            fwd_state = state
         grads, batch_stats, losses, accs = _accumulate_grads(
-            model, state, images, labels, remat=remat
+            model, fwd_state, images, labels, remat=remat
         )
         # Keep BatchNorm running stats replica-identical at every sync point:
         # with per-batch sync-BN (norm_axis_name set) this pmean is a no-op;
@@ -361,11 +475,27 @@ def make_train_step(
         # L0–L4.  Sharded: reduce-scatter + all-gather, the same wire bytes
         # split around a 1/N-sized update.
         rng = _rounding_rng(compression, seed, state.step)
-        if shard_update:
+        if level == "zero2":
             params, opt_state, grad_norm = _apply_update_sharded(
                 tx, state.params, state.opt_state, grads,
                 data_axis, axis_size, compression, rng,
             )
+        elif level == "zero1":
+            params, opt_state, grad_norm = _apply_update_zero1(
+                tx, state.params, state.opt_state, grads,
+                data_axis, axis_size, compression, rng,
+            )
+        elif level == "zero3":
+            # Same wire as zero2's scatter, but the fresh param chunks
+            # are the NEW persisted state — no publish all-gather; the
+            # next step's gather-on-demand replaces it.
+            grad_shards = sync_gradients_scatter(
+                grads, data_axis, compression, axis_size=axis_size, key=rng
+            )
+            params, opt_state = _fenced_update(
+                tx, grad_shards, state.opt_state, state.params
+            )
+            grad_norm = _psum_sq_norm(grad_shards, data_axis)
         else:
             grads = sync_gradients(
                 grads, data_axis, compression, axis_size=axis_size, key=rng
@@ -388,7 +518,7 @@ def make_train_step(
         return new_state, metrics
 
     donate = (0,) if donate_state else ()
-    if not shard_update:
+    if level == "off":
         sharded = shard_map(
             shard_body,
             mesh=mesh,
@@ -401,7 +531,7 @@ def make_train_step(
     def stepper(state: TrainState, images: jax.Array, labels: jax.Array):
         # Specs depend on the state's (chunked) structure — build them at
         # trace time from the avals; shard_map composes under jit.
-        specs = _zero1_state_specs(state, tx, data_axis)
+        specs = _zero_state_specs(state, tx, data_axis, level)
         sharded = shard_map(
             shard_body,
             mesh=mesh,
@@ -427,6 +557,11 @@ def make_train_step_gspmd(
     shard_update: bool = False,
 ) -> Callable[[TrainState, jax.Array, jax.Array], Tuple[TrainState, dict]]:
     """GSPMD train step: batch sharded over ``data`` AND H over ``space``.
+    ``shard_update`` takes the same levels as :func:`make_train_step`
+    (bool ``True`` → ``'zero2'``), expressed the GSPMD way — sharding
+    constraints instead of hand-written collectives; the Trainer's
+    ``StateLayout`` modes ``gspmd``/``gspmd_zero2``/``gspmd_zero3`` are
+    the matching placements.
 
     Where the shard_map path writes the collectives by hand, here the
     program is expressed over *global* arrays and XLA's SPMD partitioner
@@ -446,15 +581,25 @@ def make_train_step_gspmd(
       (кластер.py:328-396) applies.  The shard_map path remains the
       reference-parity codec path.
 
-    ``shard_update=True`` is the GSPMD spelling of ZeRO-1: the optimizer
-    moments stay parameter-shaped but are *partitioned* over ``data_axis``
-    (``shard_update.zero_leaf_spec`` picks the dimension), pinned by
-    sharding constraints on both the incoming state (Trainer placement)
-    and the step's output — the XLA partitioner then materializes the
-    reduce-scatter/all-gather around the elementwise update on its own
-    (the mechanism of arxiv 2004.13336).  The codec still sees the full
-    mean gradient inside the partitioned program, so no codec mode is
-    restricted on this path.
+    Levels, the GSPMD spelling (the mechanism of arxiv 2004.13336 — the
+    XLA partitioner materializes the collectives around the elementwise
+    update on its own):
+
+    - ``'zero1'``: optimizer moments stay parameter-shaped but are
+      *partitioned* over ``data_axis`` (``partition.even_shard_spec``
+      picks the dimension), pinned by sharding constraints on both the
+      incoming state (Trainer placement) and the step's output.
+    - ``'zero2'``: additionally pins the post-codec mean gradient to the
+      same rule-derived shardings, so the partitioner is told the
+      optimizer-boundary gradient is sharded (it emits a reduce-scatter
+      into the update rather than keeping a replicated mean alive).
+    - ``'zero3'``: params persist partitioned at the state boundary too
+      (rule-engine specs; uneven leaves stay replicated-by-rule) — the
+      partitioner gathers them per consuming op in the forward/backward,
+      the true gather-on-demand form.
+
+    The codec still sees the full *logical* mean gradient inside the
+    partitioned program, so no codec mode is restricted on this path.
     """
 
     if compression.mode != "none" and not compression.quantize_mean:
@@ -486,7 +631,24 @@ def make_train_step_gspmd(
     repl = NamedSharding(mesh, P())
     batch_sh = NamedSharding(mesh, P(None, data_axis, space_axis))
     n_data = mesh.shape[data_axis]
-    shard_update = shard_update and n_data > 1
+    level = zero.normalize_shard_update(shard_update)
+    if n_data <= 1:
+        level = "off"
+    layout = zero.GSPMD_LAYOUT_FOR_LEVEL.get(level)
+
+    def _constrain_by_decisions(tree: PyTree, decisions: PyTree) -> PyTree:
+        """Pin each rule-sharded leaf to its decision's sharding; leaves
+        the rules keep replicated get no constraint (the partitioner may
+        place them freely — the state boundary pins what persists)."""
+        return jax.tree.map(
+            lambda l, d: (
+                lax.with_sharding_constraint(l, NamedSharding(mesh, d.spec))
+                if d.sharded
+                else l
+            ),
+            tree,
+            decisions,
+        )
 
     def step_fn(state: TrainState, images: jax.Array, labels: jax.Array):
         grads, batch_stats, losses, accs = _accumulate_grads(
@@ -505,30 +667,46 @@ def make_train_step_gspmd(
             grads = apply_codec_fenced_bucketed(
                 resolve_codec_backend(compression), grads, compression, key=rng
             )
+        if level in ("zero2", "zero3"):
+            # ZeRO-2 the GSPMD way: pin the post-codec mean gradient to the
+            # rule-derived shardings, telling the partitioner the
+            # optimizer-boundary gradient is sharded — it materializes a
+            # reduce-scatter into the update instead of keeping a
+            # replicated mean alive between codec and update.  Values are
+            # untouched (placement only); the codec above already ran on
+            # the full logical mean, so bit-identity with the other
+            # layouts is unchanged.
+            grads = _constrain_by_decisions(
+                grads,
+                zero.param_decisions(
+                    grads, layout, n_data, data_axis, prefix="grads"
+                ),
+            )
         params, opt_state = _fenced_update(
             tx, grads, state.opt_state, state.params
         )
-        if shard_update:
+        if level != "off":
             # With the output state's shardings unconstrained at the jit
-            # boundary, pin them here: params/stats replicated (the next
-            # forward and eval/predict need them whole), fresh moments in
-            # the ZeRO layout so the partitioner keeps them sharded across
-            # steps (and therefore shards the elementwise update math that
-            # produces them) instead of replicating the output.
-            params = lax.with_sharding_constraint(params, repl)
+            # boundary, pin them here: stats replicated, params replicated
+            # (zero1/zero2 — the next forward and eval/predict need them
+            # whole) or rule-sharded (zero3 — they persist partitioned and
+            # the partitioner gathers per consuming op next step), fresh
+            # moments in the ZeRO layout so the partitioner keeps them
+            # sharded across steps (and therefore shards the elementwise
+            # update math that produces them) instead of replicating the
+            # output.
             batch_stats = lax.with_sharding_constraint(batch_stats, repl)
-            template = zero.opt_state_template(tx, state.params)
-            pshapes = zero.param_shapes(state.params)
-
-            def constrain(t, l):
-                sp = zero.opt_leaf_spec(
-                    t.shape, pshapes, "gspmd", n_data, data_axis
+            if level == "zero3":
+                params = _constrain_by_decisions(
+                    params,
+                    zero.param_decisions(params, layout, n_data, data_axis),
                 )
-                if sp is None:
-                    return l
-                return lax.with_sharding_constraint(l, NamedSharding(mesh, sp))
-
-            opt_state = jax.tree.map(constrain, template, opt_state)
+            else:
+                params = lax.with_sharding_constraint(params, repl)
+            opt_state = _constrain_by_decisions(
+                opt_state,
+                zero.opt_decisions(tx, state.params, layout, n_data, data_axis),
+            )
         metrics = {
             "loss": losses.mean(),
             "pixel_acc": accs.mean(),
@@ -542,7 +720,7 @@ def make_train_step_gspmd(
         )
         return new_state, metrics
 
-    if not shard_update:
+    if level == "off":
         return jax.jit(
             step_fn,
             in_shardings=(repl, batch_sh, batch_sh),
@@ -564,11 +742,18 @@ def make_train_step_gspmd(
         program auditor lowers it on ShapeDtypeStructs without running;
         ``stepper`` caches it for the real training loop)."""
         opt_sh = zero.opt_shardings(
-            tx, state.params, "gspmd", mesh, data_axis
+            tx, state.params, layout, mesh, data_axis
         )
+        if level == "zero3":
+            param_sh = jax.tree.map(
+                lambda d: NamedSharding(mesh, d.spec) if d.sharded else repl,
+                zero.param_decisions(state.params, layout, n_data, data_axis),
+            )
+        else:
+            param_sh = jax.tree.map(lambda _: repl, state.params)
         state_sh = state.replace(
             step=repl,
-            params=jax.tree.map(lambda _: repl, state.params),
+            params=param_sh,
             batch_stats=jax.tree.map(lambda _: repl, state.batch_stats),
             opt_state=opt_sh,
         )
@@ -602,27 +787,46 @@ def make_update_step(
     backward, for benchmarking the weight-update path in isolation
     (``bench.py --update-ab``, the ``update_ms_per_step`` contract line).
     ``grads`` is the per-replica accumulated gradient tree (replicated
-    input); ``opt_state`` must be in the matching layout (chunked when
-    ``shard_update``).  Stochastic rounding uses the shared key schedule
-    pinned at step 0 (no step counter flows through this program): every
-    call rounds with the same noise — right for timing the codec's real
-    threefry cost, wrong for training, which the fused steps own.  Same
-    ``tx`` precondition as ``make_train_step``: no cross-tree coupling
-    (e.g. ``clip_by_global_norm``) when ``shard_update``.
+    input); ``shard_update`` takes the same levels as
+    :func:`make_train_step` (bool ``True`` → ``'zero2'``), and
+    ``params``/``opt_state`` must be in the matching layout: chunked
+    moments for every chunked level, chunked params too for ``'zero3'``
+    (whose program is the zero2 wire with no publish all-gather — fresh
+    chunks ARE the output, so this arm prices exactly the persisted-
+    sharded-params update).  Stochastic rounding uses the shared key
+    schedule pinned at step 0 (no step counter flows through this
+    program): every call rounds with the same noise — right for timing
+    the codec's real threefry cost, wrong for training, which the fused
+    steps own.  Same ``tx`` precondition as ``make_train_step``: no
+    cross-tree coupling (e.g. ``clip_by_global_norm``) when sharded.
     """
     axis_size = mesh.shape[data_axis]
-    shard_update = shard_update and axis_size > 1
-    if shard_update:
+    level = zero.normalize_shard_update(shard_update)
+    if axis_size <= 1:
+        level = "off"
+    if level in ("zero2", "zero3"):
         from ddlpc_tpu.parallel.grad_sync import validate_scatter_compression
 
         validate_scatter_compression(compression)
 
     def body(params: PyTree, opt_state: PyTree, grads: PyTree):
         rng = _rounding_rng(compression, seed, 0)
-        if shard_update:
+        if level == "zero2":
             params, opt_state, _ = _apply_update_sharded(
                 tx, params, opt_state, grads,
                 data_axis, axis_size, compression, rng,
+            )
+        elif level == "zero1":
+            params, opt_state, _ = _apply_update_zero1(
+                tx, params, opt_state, grads,
+                data_axis, axis_size, compression, rng,
+            )
+        elif level == "zero3":
+            grad_shards = sync_gradients_scatter(
+                grads, data_axis, compression, axis_size=axis_size, key=rng
+            )
+            params, opt_state = _fenced_update(
+                tx, grad_shards, opt_state, params
             )
         else:
             grads = sync_gradients(
@@ -632,17 +836,20 @@ def make_update_step(
         return params, opt_state
 
     def stepper(params: PyTree, opt_state: PyTree, grads: PyTree):
-        if shard_update:
-            opt_specs = zero.opt_partition_specs(
-                tx, params, "zero1", data_axis
-            )
+        if level == "off":
+            opt_specs: PyTree = P()
+            param_specs: PyTree = P()
         else:
-            opt_specs = P()
+            # Name-matched rules place the chunked-params-derived opt
+            # template identically (same treedef, same moment names), so
+            # zero3 needs no canonical param shapes here.
+            opt_specs = zero.opt_partition_specs(tx, params, level, data_axis)
+            param_specs = P(data_axis) if level == "zero3" else P()
         sharded = shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(), opt_specs, P()),
-            out_specs=(P(), opt_specs),
+            in_specs=(param_specs, opt_specs, P()),
+            out_specs=(param_specs, opt_specs),
             check=False,
         )
         return sharded(params, opt_state, grads)

@@ -34,7 +34,11 @@ from ddlpc_tpu.ops.metrics import (
     mean_iou,
 )
 from ddlpc_tpu.parallel.mesh import initialize_distributed, make_mesh
-from ddlpc_tpu.parallel.shard_update import StateLayout, resolve_shard_update
+from ddlpc_tpu.parallel.shard_update import (
+    GSPMD_LAYOUT_FOR_LEVEL,
+    StateLayout,
+    resolve_shard_update,
+)
 from ddlpc_tpu.parallel.train_step import (
     create_train_state,
     make_eval_step,
@@ -152,9 +156,11 @@ class Trainer:
         self.model = build_model_from_experiment(cfg)
         self.spatial = cfg.parallel.space_axis_size > 1
         space = cfg.parallel.space_axis_name if self.spatial else None
-        # ZeRO-1 sharded optimizer update (parallel/shard_update.py,
-        # docs/SHARDING.md): 'auto' resolves on for data meshes > 1 unless
-        # a codec combination cannot compose; explicit 'on' raises there.
+        # ZeRO sharded-update level (parallel/shard_update.py,
+        # docs/SHARDING.md): resolves to 'off'|'zero1'|'zero2'|'zero3'.
+        # 'auto' picks zero2 for data meshes > 1 unless a codec
+        # combination cannot compose (those fall back to 'off' — explicit
+        # levels raise there instead).
         self.shard_update = resolve_shard_update(
             cfg.parallel.shard_update,
             cfg.compression,
@@ -227,15 +233,21 @@ class Trainer:
             jax.random.key(cfg.train.seed),
             (1, h, w, channels),
         )
-        # Run layout: replicated, or — under the sharded update — the Adam
-        # moments chunked (shard_map path) / partitioned (GSPMD path) over
-        # the data axis, 1/N per device.  ``layout`` converts both ways;
-        # checkpoints and multi-host broadcasts always move the canonical
-        # (gathered) layout, so on-disk state is layout-independent.
+        # Run layout: replicated, or — under the sharded update — the
+        # level's persistent shards: Adam moments chunked 1/N (zero1/2/3),
+        # plus the params themselves under zero3; the GSPMD path expresses
+        # the same placements as NamedShardings (gspmd/gspmd_zero2/
+        # gspmd_zero3).  ``layout`` converts both ways; checkpoints and
+        # multi-host broadcasts always move the canonical (gathered)
+        # layout, so on-disk state is layout-independent.
         layout_mode = (
-            ("gspmd" if self.spatial else "zero1")
-            if self.shard_update
-            else "replicated"
+            "replicated"
+            if self.shard_update == "off"
+            else (
+                GSPMD_LAYOUT_FOR_LEVEL[self.shard_update]
+                if self.spatial
+                else self.shard_update
+            )
         )
         self.layout = StateLayout(
             layout_mode,
@@ -310,21 +322,33 @@ class Trainer:
                 # first breadcrumb write, debited as category 'restart'.
                 restart_gap_s=obs_flops.restart_gap_seconds(cfg.workdir),
             )
-            obs_hbm.publish_hbm_gauges(self.registry, self.state)
+            obs_hbm.publish_hbm_gauges(
+                self.registry,
+                self.state,
+                level=self.shard_update,
+                n_shards=data_size,
+                replicated_by_rule=self.layout.replicated_by_rule_bytes(),
+            )
             if cfg.compression.transport == "ring" and cfg.compression.mode != "none":
                 variant = "ring"
             elif self.spatial:
                 variant = "gspmd"
-            elif self.shard_update:
+            elif self.shard_update == "zero2":
                 variant = "scatter"
+            elif self.shard_update in ("zero1", "zero3"):
+                variant = self.shard_update
             else:
                 variant = "allreduce"
-            n_params = obs_comm.tree_elements(self.state.params)
+            # Canonical (unchunked) parameter shapes: under zero3 the
+            # placed params are [N, K] chunks, but the wire accounting
+            # and the probe model the sync over the logical grads.
+            canonical_params = self.layout.param_avals
+            n_params = obs_comm.tree_elements(canonical_params)
             from ddlpc_tpu.parallel.grad_sync import grad_bucket_groups
 
             n_buckets = len(
                 grad_bucket_groups(
-                    self.state.params, cfg.compression.bucket_mb
+                    canonical_params, cfg.compression.bucket_mb
                 )
             )
             self.comm = obs_comm.CommAccountant(
@@ -344,14 +368,14 @@ class Trainer:
                 # (donated) param buffers alive.
                 param_shapes = jax.tree.map(
                     lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype),
-                    self.state.params,
+                    canonical_params,
                 )
                 self._comm_probe = obs_comm.make_comm_probe(
                     self.mesh,
                     cfg.compression,
                     param_shapes,
                     data_axis=cfg.parallel.data_axis_name,
-                    scatter=self.shard_update,
+                    scatter=self.shard_update in ("zero2", "zero3"),
                     seed=cfg.train.seed,
                 )
 
@@ -500,6 +524,9 @@ class Trainer:
             remat=cfg.train.remat,
             seed=cfg.train.seed,
             shard_update=self.shard_update,
+            # zero3's gather-on-demand restores chunks to these canonical
+            # shapes; harmless (ignored) at every other level.
+            param_avals=self.layout.param_avals,
         )
 
     def _restore_synchronized(self) -> None:
@@ -845,7 +872,12 @@ class Trainer:
         # Strip the optimizer state from the eval input: the eval steps pin
         # the state replicated, and resharding sharded Adam moments into an
         # unused argument would all-gather them once per eval batch.
-        eval_state = self.state.replace(opt_state=())
+        # Under zero3 the run-layout params are [N, K] chunks — gather
+        # them once per evaluation (layout.full_params is the identity
+        # for every other layout), not once per batch.
+        eval_state = self.state.replace(
+            params=self.layout.full_params(self.state), opt_state=()
+        )
         for images, labels in eval_batches(
             self.test_ds,
             self.mesh,
@@ -886,7 +918,12 @@ class Trainer:
             return
         images = self.test_ds.images[:n]
         labels = self.test_ds.labels[:n]
-        preds = np.asarray(self.predict(self.state, images))
+        # full_params: identity except under zero3, where the run-layout
+        # params are chunks the predict fn cannot apply.
+        predict_state = self.state.replace(
+            params=self.layout.full_params(self.state)
+        )
+        preds = np.asarray(self.predict(predict_state, images))
         dump_prediction_triples(
             self.workdir,
             images,

@@ -122,6 +122,7 @@ MEASURED_PATH_MODULES = (
     "ddlpc_tpu/parallel/bucketing.py",
     "ddlpc_tpu/parallel/compressed_allreduce.py",
     "ddlpc_tpu/parallel/grad_sync.py",
+    "ddlpc_tpu/parallel/partition.py",
     "ddlpc_tpu/parallel/shard_update.py",
     "ddlpc_tpu/parallel/train_step.py",
     "bench.py",
@@ -426,24 +427,27 @@ def arm_step_and_comm(rounds: int) -> Dict[str, float]:
         grad_clip_norm=cfg.train.grad_clip_norm,
     )
     layout = StateLayout(
-        "zero1" if sharded else "replicated", tx, state, mesh, "data"
+        "replicated" if sharded == "off" else sharded, tx, state, mesh, "data"
     )
     param_shapes = jax.tree.map(
         lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), state.params
     )
     state = layout.place(state)
     update_ms = bench.measure_update_ms(
-        tx, mesh, cfg.compression, state, sharded, rounds=rounds
+        tx, mesh, cfg.compression, state, sharded, rounds=rounds,
+        param_avals=layout.param_avals,
     )
 
     probe = make_comm_probe(
-        mesh, cfg.compression, param_shapes, scatter=sharded,
+        mesh, cfg.compression, param_shapes,
+        scatter=sharded in ("zero2", "zero3"),
         seed=cfg.train.seed,
     )
     comm_ms = min(probe() for _ in range(max(rounds, 2))) * 1e3
 
     step = make_train_step(
-        model, tx, mesh, cfg.compression, shard_update=sharded
+        model, tx, mesh, cfg.compression, shard_update=sharded,
+        param_avals=layout.param_avals,
     )
     A = cfg.train.sync_period
     B = cfg.train.micro_batch_size * n
@@ -477,10 +481,14 @@ def arm_step_and_comm(rounds: int) -> Dict[str, float]:
         cfg.compression, bucket_mb=OVERLAP_BUCKET_MB
     )
     probe_b = make_comm_probe(
-        mesh, comp_b, param_shapes, scatter=sharded, seed=cfg.train.seed
+        mesh, comp_b, param_shapes,
+        scatter=sharded in ("zero2", "zero3"), seed=cfg.train.seed,
     )
     comm_b_ms = min(probe_b() for _ in range(max(rounds, 2))) * 1e3
-    step_b = make_train_step(model, tx, mesh, comp_b, shard_update=sharded)
+    step_b = make_train_step(
+        model, tx, mesh, comp_b, shard_update=sharded,
+        param_avals=layout.param_avals,
+    )
     for _ in range(2):
         state, metrics = step_b(state, images, labels)
         float(metrics["loss"])

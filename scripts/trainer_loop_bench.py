@@ -75,9 +75,10 @@ def main() -> None:
     trainer = Trainer(cfg, resume=False)
     # Per-step update-path breakdown (same program family the fused step
     # embeds, timed in isolation — bench.py's update-only microbench).
-    # Only the pure data mesh: make_update_step speaks the zero1 chunk
-    # layout, not the GSPMD param-shaped one a spatial trainer places
-    # (measure_update_ms requires the state in its matching run layout).
+    # Only the pure data mesh: make_update_step speaks the chunk layouts
+    # (zero1/zero2/zero3), not the GSPMD param-shaped one a spatial
+    # trainer places (measure_update_ms requires the state in its
+    # matching run layout).
     update_ms = None
     if not trainer.spatial:
         from bench import measure_update_ms
@@ -89,6 +90,7 @@ def main() -> None:
             trainer.state,
             trainer.shard_update,
             rounds=2,
+            param_avals=trainer.layout.param_avals,
         )
     trainer.fit()
 
@@ -113,7 +115,8 @@ def main() -> None:
         "bench_tiles_per_s": args.bench_tiles_per_s,
         "ratio_vs_bench": round(sustained / args.bench_tiles_per_s, 3),
         "wrap_fill_factor": records[-1].get("wrap_fill_factor"),
-        "shard_update": bool(trainer.shard_update),
+        # Resolved ZeRO level string ("off"|"zero1"|"zero2"|"zero3").
+        "shard_update": trainer.shard_update,
         "update_ms_per_step": (
             round(update_ms, 3) if update_ms is not None else None
         ),

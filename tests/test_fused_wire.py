@@ -265,15 +265,15 @@ def test_ring_rejects_bucketing():
 
 
 def test_census_counts_same_buckets_in_every_layout():
-    """Satellite pin: replicated, ZeRO-1 and GSPMD derive their buckets
+    """Satellite pin: replicated, ZeRO-2 and GSPMD derive their buckets
     from the same parameter tree, and the auditor can READ the bucket
     count back off each traced program — B scale pmaxes (replicated
-    fused), 2B (ZeRO-1 fused + quantized mean), 2B fence pairs (GSPMD's
+    fused), 2B (ZeRO-2 fused + quantized mean), 2B fence pairs (GSPMD's
     per-bucket mean codec)."""
     from ddlpc_tpu.analysis import program as prog
 
     b_rep = prog.build_program("int8_bucketed/update_step")
-    b_z1 = prog.build_program("fp16_bucketed_zero1/update_step")
+    b_z1 = prog.build_program("fp16_bucketed_zero2/update_step")
     b_gs = prog.build_program("fp16_bucketed_gspmd/train_step")
     B = b_rep.declared.n_buckets
     assert B > 1  # the audit model + bucket_mb=0.02 actually buckets
@@ -300,10 +300,10 @@ def test_census_counts_same_buckets_in_every_layout():
     )
 
     a_z1 = prog.audit_program(
-        "fp16_bucketed_zero1/update_step", fast=True, bundle=b_z1
+        "fp16_bucketed_zero2/update_step", fast=True, bundle=b_z1
     )
     assert a_z1.violations == [], [v.format() for v in a_z1.violations]
-    # ZeRO-1 fused + quantized mean: two scale pmaxes per bucket, plus
+    # ZeRO-2 fused + quantized mean: two scale pmaxes per bucket, plus
     # the jaxpr-only dead grad-norm psum XLA DCEs (auditor declares it).
     assert f32_allreduce_count(a_z1.jaxpr_census) == 2 * B + 1
     assert any(

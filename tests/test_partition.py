@@ -1,0 +1,260 @@
+"""Declarative regex partition-rule engine (parallel/partition.py).
+
+The table is the single owner of every placement decision — these tests
+pin its mechanics (ordering, totality, the SHARD sentinel's per-mode
+resolution, the explicit replicated-by-rule budget) and the ladder
+semantics of ``state_partition_rules``.  The integration surfaces
+(StateLayout placement, GSPMD constraints, checkpoint roundtrips, the
+compiled-program sharding contract) are pinned by test_shard_update.py
+and test_program_audit.py on the same decision trees.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ddlpc_tpu.parallel import partition
+from ddlpc_tpu.parallel import shard_update as zero
+from ddlpc_tpu.parallel.partition import (
+    Decision,
+    REASON_AUTO,
+    REASON_NOT_PARAM_SHAPED,
+    REASON_REPLICATED_BY_RULE,
+    REASON_RULE,
+    Rule,
+    SHARD,
+    decide,
+    decide_tree,
+    even_shard_spec,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+    named_leaves,
+    replicated_by_rule_bytes,
+    state_partition_rules,
+)
+
+
+# -- rule matching ----------------------------------------------------------
+
+def test_first_match_wins_in_order():
+    rules = (
+        Rule(r"kernel", P("data")),
+        Rule(r"Conv_0/kernel", P()),  # shadowed: never reached
+        Rule(r".*", SHARD),
+    )
+    assert match_partition_rules(rules, "params/Conv_0/kernel").spec == P(
+        "data"
+    )
+    assert match_partition_rules(rules, "params/Conv_0/bias").spec is SHARD
+
+
+def test_unmatched_leaf_is_an_error_not_a_default():
+    """A leaf no rule covers raises — silent replication by fallthrough
+    is the failure mode the PR 13 sharding contract exists to catch."""
+    with pytest.raises(ValueError, match="no partition rule matches"):
+        match_partition_rules((Rule(r"^params/", SHARD),), "opt_state/count")
+
+
+def test_named_leaves_paths():
+    tree = {"mu": {"Conv_0": {"kernel": jnp.zeros((3, 4))}}, "count": jnp.zeros(())}
+    names = dict(named_leaves(tree, "opt_state"))
+    assert set(names) == {"opt_state/mu/Conv_0/kernel", "opt_state/count"}
+
+
+# -- even_shard_spec (GSPMD auto-placement) ---------------------------------
+
+def test_even_shard_spec_picks_largest_even_dim():
+    assert even_shard_spec((3, 3, 4, 8), 4, "data") == P(None, None, None, "data")
+    # 16 > 8 and both divide evenly -> the larger wins.
+    assert even_shard_spec((16, 8), 4, "data") == P("data", None)
+    # Largest dim (6) does not divide by 4; next (4) does.
+    assert even_shard_spec((6, 4), 4, "data") == P(None, "data")
+
+
+def test_even_shard_spec_refuses_uneven():
+    """No evenly-divisible dim → P() — an uneven NamedSharding would be
+    rejected at the jit state boundary, so the engine replicates with an
+    explicit reason instead (the PR 13 auditor-surfaced bug)."""
+    assert even_shard_spec((6,), 4, "data") == P()
+    assert even_shard_spec((3, 2), 4, "data") == P()
+    assert even_shard_spec((), 4, "data") == P()
+
+
+# -- decide -----------------------------------------------------------------
+
+_RULES = (
+    Rule(r"^opt_state/(.*/)?(mu|nu|trace)(/|$)", SHARD),
+    Rule(r".*", P()),
+)
+
+
+def test_decide_concrete_rule():
+    d = decide(
+        (Rule(r".*", P("data")),), "params/w", (8, 8),
+        mode="leaf", n_shards=4, data_axis="data",
+    )
+    assert d.spec == P("data") and d.reason == REASON_RULE and d.sharded
+
+
+def test_decide_chunk_mode_shards_on_data():
+    d = decide(
+        _RULES, "opt_state/0/mu/Conv_0/kernel", (3, 3, 4, 4),
+        mode="chunk", n_shards=4, data_axis="data",
+    )
+    assert d.spec == P("data") and d.reason == REASON_AUTO
+    assert d.rule == _RULES[0].pattern
+
+
+def test_decide_leaf_mode_uneven_is_replicated_by_rule():
+    d = decide(
+        _RULES, "opt_state/0/mu/Conv_0/bias", (6,),
+        mode="leaf", n_shards=4, data_axis="data",
+    )
+    assert d.spec == P() and d.reason == REASON_REPLICATED_BY_RULE
+    assert not d.sharded
+
+
+def test_decide_param_shape_gate():
+    """A SHARD-matched leaf that is not parameter-shaped (step counter a
+    too-broad rule caught) stays replicated with its own reason."""
+    d = decide(
+        (Rule(r".*", SHARD),), "opt_state/count", (),
+        mode="chunk", n_shards=4, data_axis="data", param_shaped=False,
+    )
+    assert d.spec == P() and d.reason == REASON_NOT_PARAM_SHAPED
+
+
+def test_decide_rejects_unknown_mode():
+    with pytest.raises(ValueError, match="mode"):
+        decide(_RULES, "x", (4,), mode="auto", n_shards=4, data_axis="data")
+
+
+# -- the state-wide ladder tables -------------------------------------------
+
+@pytest.mark.parametrize(
+    "level,want",
+    [
+        ("replicated", {"params": False, "grads": False, "mu": False}),
+        ("zero1", {"params": False, "grads": False, "mu": True}),
+        ("zero2", {"params": False, "grads": True, "mu": True}),
+        ("zero3", {"params": True, "grads": True, "mu": True}),
+    ],
+)
+def test_state_rules_ladder(level, want):
+    rules = state_partition_rules(level)
+    names = {
+        "params": "params/Conv_0/kernel",
+        "grads": "grads/Conv_0/kernel",
+        "mu": "opt_state/0/mu/Conv_0/kernel",
+    }
+    for key, name in names.items():
+        rule = match_partition_rules(rules, name)
+        assert (rule.spec is SHARD) == want[key], (level, name)
+    # Totality: scalars and stats always land on the catch-all.
+    for name in ("opt_state/0/count", "batch_stats/BatchNorm_0/mean", "step"):
+        assert match_partition_rules(rules, name).spec == P()
+
+
+def test_state_rules_moment_pattern_is_surgical():
+    """The moment rule must not swallow non-moment opt_state leaves: a
+    hypothetical leaf literally named like a moment's parent but not
+    mu/nu/trace stays replicated."""
+    rules = state_partition_rules("zero1")
+    assert match_partition_rules(rules, "opt_state/0/mu/w").spec is SHARD
+    assert match_partition_rules(rules, "opt_state/0/nu_hat/w").spec == P()
+    assert match_partition_rules(rules, "opt_state/0/count").spec == P()
+
+
+def test_state_rules_unknown_level():
+    with pytest.raises(ValueError, match="unknown ZeRO level"):
+        state_partition_rules("zero4")
+
+
+# -- decision trees + budget -------------------------------------------------
+
+def _tiny_state_tree():
+    return {
+        "params": {"w": jnp.zeros((8, 4)), "b": jnp.zeros((6,))},
+        "opt_state": {"mu": {"w": jnp.zeros((8, 4)), "b": jnp.zeros((6,)),
+                             "scale": jnp.zeros(())},
+                      "count": jnp.zeros((), jnp.int32)},
+    }
+
+
+def test_decide_tree_leaf_mode_budget():
+    """In leaf (GSPMD) mode the uneven bias replicates by rule, and
+    replicated_by_rule_bytes charges exactly those leaves."""
+    tree = _tiny_state_tree()
+    pshapes = frozenset({(8, 4), (6,)})
+    decisions = decide_tree(
+        state_partition_rules("zero3"), tree, "",
+        mode="leaf", n_shards=4, data_axis="data", pshapes=pshapes,
+    )
+    flat = {d.name: d for d in jax.tree.leaves(decisions)}
+    assert flat["params/w"].reason == REASON_AUTO
+    assert flat["params/b"].reason == REASON_REPLICATED_BY_RULE
+    assert flat["opt_state/mu/b"].reason == REASON_REPLICATED_BY_RULE
+    # count lands on the concrete catch-all (no gate needed)...
+    assert flat["opt_state/count"].reason == REASON_RULE
+    # ...while a SHARD-matched scalar is caught by the param-shape gate.
+    assert flat["opt_state/mu/scale"].reason == REASON_NOT_PARAM_SHAPED
+    # two f32[6] leaves decided replicated-by-rule -> 2 * 6 * 4 bytes.
+    assert replicated_by_rule_bytes(decisions, tree) == 48
+
+
+def test_zero_leaf_spec_delegates_to_rule_engine():
+    """shard_update.zero_leaf_spec is the rule engine's even_shard_spec —
+    one resolver for every SHARD decision (the satellite: the replicated
+    fallback is a rule-engine decision, not a special case)."""
+    assert zero.zero_leaf_spec((16, 8), 4, "data") == even_shard_spec(
+        (16, 8), 4, "data"
+    )
+    assert zero.zero_leaf_spec((6,), 4, "data") == P()
+
+
+# -- checkpoint shard/gather fns --------------------------------------------
+
+def test_shard_gather_fns_chunk_roundtrip():
+    tree = _tiny_state_tree()
+    pshapes = frozenset({(8, 4), (6,)})
+    decisions = decide_tree(
+        state_partition_rules("zero3"), tree, "",
+        mode="chunk", n_shards=4, data_axis="data", pshapes=pshapes,
+    )
+    shard_fns, gather_fns = make_shard_and_gather_fns(decisions, 4, "chunk")
+    rng = np.random.default_rng(0)
+    full = jax.tree.map(
+        lambda l: jnp.asarray(
+            rng.standard_normal(l.shape).astype(np.float32)
+        )
+        if l.dtype == jnp.float32 else l,
+        tree,
+    )
+    placed = jax.tree.map(lambda f, x: f(x), shard_fns, full)
+    # Auto-sharded leaves landed in the [N, K] chunk view...
+    assert placed["params"]["w"].shape == (4, zero.chunk_rows(32, 4))
+    assert placed["params"]["b"].shape == (4, zero.chunk_rows(6, 4))
+    # ...the gate-kept scalar did not.
+    assert placed["opt_state"]["count"].shape == ()
+    back = jax.tree.map(lambda f, x: f(x), gather_fns, placed)
+    for a, b in zip(jax.tree.leaves(full), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_shard_gather_fns_leaf_mode_is_identity():
+    """GSPMD layouts keep parameter shapes — checkpoint fns are the
+    identity; placement is sharding-only."""
+    tree = _tiny_state_tree()
+    decisions = decide_tree(
+        state_partition_rules("zero2"), tree, "",
+        mode="leaf", n_shards=4, data_axis="data",
+    )
+    shard_fns, gather_fns = make_shard_and_gather_fns(decisions, 4, "leaf")
+    placed = jax.tree.map(lambda f, x: f(x), shard_fns, tree)
+    for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(placed)):
+        assert a.shape == b.shape
+    with pytest.raises(ValueError, match="mode"):
+        make_shard_and_gather_fns(decisions, 4, "sideways")

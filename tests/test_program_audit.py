@@ -189,7 +189,7 @@ def test_expected_fences_matrix():
     f = lambda name, kind: prog.expected_fences(prog.ARMS[name], kind)
     assert f("none_simulate", "update_step") == 2   # _fenced_update only
     assert f("int8_simulate", "update_step") == 6   # local + mean + update
-    assert f("fp16_zero1", "train_step") == 6       # scatter mean stage fenced
+    assert f("fp16_zero2", "train_step") == 6       # scatter mean stage fenced
     assert f("int8_ring", "update_step") == 2       # ring owns its collective
     assert f("fp16_gspmd", "train_step") == 4       # one codec fence + update
     assert f("int8_simulate", "eval_step") == 0
