@@ -334,6 +334,27 @@ class ParallelConfig:
     #   (always stored gathered); every layout restores from every
     #   other's blobs bit-identically.
     shard_update: str = "auto"  # auto | on | off | zero1 | zero2 | zero3
+    # MPMD pipeline parallelism (docs/SHARDING.md "Pipeline stages",
+    # arxiv 2412.14374): cut the encoder–decoder into `pipeline_stages`
+    # contiguous block groups (parallel/partition.py stage rules) and map
+    # each group onto its own (data, space) sub-mesh along a third `pipe`
+    # mesh axis (parallel/mesh.py).  1 (default) = unstaged — the mesh
+    # and every compiled program are bit-identical to pre-pipeline
+    # revisions (test-pinned).  Values > 1 must divide the device count
+    # after the space axis takes its share; the stage cut is chosen by
+    # balanced per-block parameter bytes, so per-device resident
+    # params+grads+moments shrink toward 1/stages (obs/hbm.py prices it,
+    # bench.py --pipeline-ab measures it).
+    pipeline_stages: int = 1
+    # Microbatches per optimizer step under the GPipe round-robin
+    # schedule (parallel/pipeline.py): the bubble fraction is
+    # (S-1)/(M+S-1), so more microbatches amortize the fill/drain bubble
+    # (кластер.py's 50-step gradient accumulation is exactly this stream).
+    # 0 (default) resolves to `pipeline_stages` when staged; ignored at
+    # pipeline_stages=1, where TrainConfig.sync_period already plays the
+    # accumulation role.
+    pipeline_microbatches: int = 0
+    pipe_axis_name: str = "pipe"
 
 
 @dataclass(frozen=True)

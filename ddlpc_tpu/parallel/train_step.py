@@ -108,6 +108,17 @@ def _loss_and_metrics(
     else:
         logits = model.apply(variables, images, train=False)
         new_stats = batch_stats
+    loss, acc = loss_from_logits(model, logits, labels, train)
+    return loss, (new_stats, acc)
+
+
+def loss_from_logits(
+    model: nn.Module, logits: jax.Array, labels: jax.Array, train: bool
+) -> Tuple[jax.Array, jax.Array]:
+    """The loss/accuracy tail of :func:`_loss_and_metrics`, factored out
+    so the pipeline's last-stage segment (parallel/pipeline.py) applies
+    byte-identical loss math to logits produced by staged execution —
+    one owner for the grouped-head regrouping and the void-pixel mean."""
     # train_head_layout='grouped': the model returned pre-d2s phase-major
     # logits [..., H/r, W/r, r²·C] (models/layers.py:group_labels).  Group
     # the labels the same way and run the SAME loss/metric functions on the
@@ -159,7 +170,7 @@ def _loss_and_metrics(
     denom = jnp.maximum(valid.sum(), 1.0)
     loss = (nll * valid).sum() / denom
     acc = (correct * valid).sum() / denom
-    return loss, (new_stats, acc)
+    return loss, acc
 
 
 def _accumulate_grads(
@@ -424,7 +435,9 @@ def make_train_step(
             raise ValueError(
                 f"mesh axis {name!r} (size {size}) is not consumed by the "
                 f"shard_map train step — use make_train_step_gspmd for "
-                f"data×space meshes (the Trainer selects it automatically)"
+                f"data×space meshes (the Trainer selects it automatically) "
+                f"or parallel/pipeline.make_pipeline_train_step for meshes "
+                f"with a pipe axis"
             )
     axis_size = mesh.shape[data_axis]
     level = zero.normalize_shard_update(shard_update)

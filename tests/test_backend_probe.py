@@ -50,3 +50,50 @@ def test_probe_grace_catches_late_success(monkeypatch):
     # Deadline misses, the grace re-check catches the late completion.
     result = backend_probe.probe_backend(0.1, grace_s=2.0)
     assert result == real_devices
+
+
+def _load_bench():
+    import importlib.util
+    import os
+
+    path = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    )
+    spec = importlib.util.spec_from_file_location("_bench_under_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_cpu_fallback_emits_contract_lines():
+    """Probe-failure path: the CPU-feasible A/B arms emit their REAL
+    contract lines with an honest backend field and the probe's reason —
+    not one null-valued metric.  A dead arm degrades to a null record
+    carrying its error without masking the others."""
+    bench = _load_bench()
+    calls = []
+
+    def fake_runner(name, rounds):
+        calls.append((name, rounds))
+        if name == "update_ab":
+            return {"metric": "update_ms_per_step", "value": 1.5, "unit": "ms"}
+        raise RuntimeError("child died")
+
+    recs = bench.run_cpu_fallback(
+        "tunnel unreachable", 2,
+        "unet_vaihingen512_train_tiles_per_sec_per_chip",
+        runner=fake_runner,
+    )
+    assert [c[0] for c in calls] == list(bench.CPU_FALLBACK_ARMS)
+    assert [c[1] for c in calls] == [2, 2]
+    assert len(recs) == len(bench.CPU_FALLBACK_ARMS)
+    for rec in recs:
+        assert rec["backend"] == "cpu"
+        assert rec["fallback_reason"] == "tunnel unreachable"
+        assert (
+            rec["requested_metric"]
+            == "unet_vaihingen512_train_tiles_per_sec_per_chip"
+        )
+    ok, dead = recs
+    assert ok["metric"] == "update_ms_per_step" and ok["value"] == 1.5
+    assert dead["value"] is None and "child died" in dead["error"]

@@ -1,0 +1,395 @@
+"""MPMD pipeline parallelism (parallel/pipeline.py): stage plan + rule
+anchoring, split/merge round-trips, the GPipe driver's semantics against
+the unstaged builder, ZeRO-in-stage bit-identity, and the cross-layout
+checkpoint matrix through the canonical gathered layout.
+
+All on the virtual 8-device CPU mesh (conftest): pipe=2 × data=4 for the
+staged arms, a 4-device data mesh for the equal-width monolithic
+reference.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ddlpc_tpu.config import CompressionConfig, ParallelConfig
+from ddlpc_tpu.models.unet import UNet
+from ddlpc_tpu.parallel import partition
+from ddlpc_tpu.parallel.mesh import make_mesh, stage_meshes
+from ddlpc_tpu.parallel.pipeline import (
+    PipelineTrainStep,
+    build_stage_plan,
+    bubble_fraction,
+    make_pipeline_train_step,
+    merge_opt_state,
+    split_opt_state,
+    stage_param_bytes,
+)
+from ddlpc_tpu.parallel.train_step import create_train_state, make_train_step
+
+M, B, H, W, C, NC = 4, 8, 16, 16, 3, 4
+
+
+def tiny_model(**kw):
+    return UNet(
+        num_classes=NC,
+        features=(4, 8),
+        bottleneck_features=8,
+        norm="batch",
+        norm_axis_name=None,
+        dtype=jnp.float32,
+        **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = tiny_model()
+    tx = optax.adam(1e-3)
+    # Host copy: drivers donate their placed buffers, and a device_put off
+    # a device-resident source may alias shards with it — a host tree makes
+    # every placement mint fresh buffers.
+    full = jax.device_get(
+        create_train_state(model, tx, jax.random.key(0), (1, H, W, C))
+    )
+    kx, ky = jax.random.split(jax.random.key(1))
+    images = np.asarray(jax.random.normal(kx, (M, B, H, W, C), jnp.float32))
+    labels = np.asarray(jax.random.randint(ky, (M, B, H, W), 0, NC))
+    return model, tx, full, images, labels
+
+
+def _named(tree):
+    return dict(partition.named_leaves(tree))
+
+
+def _assert_trees_byte_equal(a, b, what=""):
+    na, nb = _named(a), _named(b)
+    assert na.keys() == nb.keys(), what
+    for k in na:
+        x, y = np.asarray(na[k]), np.asarray(nb[k])
+        assert x.dtype == y.dtype, f"{what}:{k}"
+        np.testing.assert_array_equal(x, y, err_msg=f"{what}:{k}")
+
+
+def _max_abs_diff(a, b):
+    na, nb = _named(a), _named(b)
+    return max(
+        float(np.max(np.abs(np.asarray(na[k], np.float32) - np.asarray(nb[k], np.float32))))
+        for k in na
+    )
+
+
+# -- model / plan -----------------------------------------------------------
+
+
+def test_bubble_fraction_model():
+    assert bubble_fraction(1, 4) == 0.0
+    assert bubble_fraction(2, 4) == pytest.approx(1 / 5)
+    assert bubble_fraction(4, 4) == pytest.approx(3 / 7)
+    with pytest.raises(ValueError):
+        bubble_fraction(0, 4)
+    with pytest.raises(ValueError):
+        bubble_fraction(2, 0)
+
+
+def test_balanced_assignment_properties():
+    bb = [4, 1, 1, 1, 1, 8, 2]
+    a = partition.balanced_stage_assignment(bb, 3)
+    assert len(a) == len(bb)
+    assert a == sorted(a), "stage assignment must be non-decreasing"
+    assert set(a) == {0, 1, 2}, "every stage must own at least one block"
+    # Optimal max share for this list is 8 (the heavy block alone).
+    shares = [sum(b for b, s in zip(bb, a) if s == k) for k in range(3)]
+    assert max(shares) == 8
+    with pytest.raises(ValueError):
+        partition.balanced_stage_assignment([1, 2], 3)
+    with pytest.raises(ValueError):
+        partition.balanced_stage_assignment([1, 2], 0)
+
+
+def test_stage_rules_are_start_anchored():
+    # Regression: block names recur NESTED (every DownBlock holds an inner
+    # DoubleConv_0), so a float-anchored rule table would let the
+    # bottleneck's 'DoubleConv_0' rule steal encoder leaves.
+    rules = partition.stage_rules_for_blocks(
+        ["DownBlock_0", "DoubleConv_0"], [0, 1]
+    )
+    assert (
+        partition.match_stage_rules(
+            rules, "DownBlock_0/DoubleConv_0/Conv_0/kernel"
+        )
+        == 0
+    )
+    assert (
+        partition.match_stage_rules(rules, "DoubleConv_0/Conv_0/kernel") == 1
+    )
+    with pytest.raises(ValueError, match="no stage rule matches"):
+        partition.match_stage_rules(rules, "UpBlock_0/DoubleConv_0/kernel")
+
+
+def test_plan_split_merge_roundtrip(setup):
+    model, tx, full, _, _ = setup
+    plan = build_stage_plan(model, full.params, 2)
+    assert plan.assignment == tuple(sorted(plan.assignment))
+    split = plan.split(full.params)
+    assert len(split) == 2
+    _assert_trees_byte_equal(plan.merge(split), full.params, "params")
+    # The balanced cut actually balances: no stage above ~85% of the total
+    # (the decoder-heavy U-Net would put ~90%+ on one side of a naive
+    # halfway block cut).
+    bytes_per = stage_param_bytes(plan, full.params)
+    assert max(bytes_per) <= 0.85 * sum(bytes_per)
+
+
+def test_opt_state_split_merge_roundtrip(setup):
+    model, tx, full, _, _ = setup
+    plan = build_stage_plan(model, full.params, 2)
+    p_split = plan.split(full.params)
+    o_split = split_opt_state(tx, full.opt_state, p_split)
+    merged = merge_opt_state(tx, full.params, o_split)
+    _assert_trees_byte_equal(merged, full.opt_state, "opt_state")
+
+
+def test_carry_protocol_validation():
+    model = tiny_model()
+    x = jnp.zeros((1, H, W, C))
+    variables = model.init(jax.random.key(0), x, train=False)
+    with pytest.raises(ValueError, match="contiguous"):
+        model.apply(
+            variables, x, train=False, blocks=("DownBlock_0", "DoubleConv_0")
+        )
+    with pytest.raises(ValueError, match="first stage"):
+        model.apply(
+            variables, x, train=False,
+            blocks=("DoubleConv_0",), carry=None,
+        )
+    with pytest.raises(ValueError, match="first stage"):
+        model.apply(
+            variables, x, train=False,
+            blocks=("DownBlock_0", "DownBlock_1"),
+            carry={"x": x, "skips": ()},
+        )
+
+
+# -- driver vs the unstaged builder ----------------------------------------
+
+
+def test_pipe1_delegates_bit_identical(setup):
+    """Satellite contract: the pipe=1 degenerate path IS the unstaged
+    builder — same program, bit-identical trajectory."""
+    model, tx, full, images, labels = setup
+    mesh = make_mesh(ParallelConfig())
+    comp = CompressionConfig()
+    drv = make_pipeline_train_step(model, tx, mesh, comp, n_microbatches=M)
+    assert drv.n_stages == 1
+    pstate = drv.init_state(full)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mono = make_train_step(model, tx, mesh, comp, donate_state=False)
+    ref = jax.device_put(full, NamedSharding(mesh, P()))
+    bsh = NamedSharding(mesh, P(None, "data"))
+    im, lb = jax.device_put(images, bsh), jax.device_put(labels, bsh)
+    for _ in range(2):
+        pstate, pm = drv.step(pstate, images, labels)
+        ref, rm = mono(ref, im, lb)
+        assert pm["loss"] == pytest.approx(float(np.asarray(rm["loss"])))
+    can = drv.canonical(pstate)
+    _assert_trees_byte_equal(can.params, jax.device_get(ref.params), "params")
+    _assert_trees_byte_equal(
+        can.batch_stats, jax.device_get(ref.batch_stats), "batch_stats"
+    )
+    _assert_trees_byte_equal(
+        can.opt_state, jax.device_get(ref.opt_state), "opt_state"
+    )
+
+
+@pytest.fixture(scope="module")
+def pipe2(setup):
+    model, tx, full, images, labels = setup
+    mesh = make_mesh(ParallelConfig(pipeline_stages=2))
+    drv = make_pipeline_train_step(
+        model, tx, mesh, CompressionConfig(), n_microbatches=M
+    )
+    pstate = drv.init_state(full)
+    steps = []
+    for _ in range(3):
+        pstate, pm = drv.step(pstate, images, labels)
+        steps.append(pm)
+    return drv, pstate, steps
+
+
+def test_pipe2_matches_monolithic(setup, pipe2):
+    """Staged 2-stage round-robin == the equal-width (data=4) monolithic
+    step on the same microbatch stream, to fp reassociation tolerance:
+    the schedule changes WHERE ops run, not the math."""
+    model, tx, full, images, labels = setup
+    drv, pstate, steps = pipe2
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh4 = make_mesh(ParallelConfig(data_axis_size=4), jax.devices()[:4])
+    mono = make_train_step(
+        model, tx, mesh4, CompressionConfig(), donate_state=False
+    )
+    ref = jax.device_put(full, NamedSharding(mesh4, P()))
+    bsh = NamedSharding(mesh4, P(None, "data"))
+    im, lb = jax.device_put(images, bsh), jax.device_put(labels, bsh)
+    for i in range(3):
+        ref, rm = mono(ref, im, lb)
+        assert steps[i]["loss"] == pytest.approx(
+            float(np.asarray(rm["loss"])), abs=1e-5
+        )
+    can = drv.canonical(pstate)
+    assert _max_abs_diff(can.params, jax.device_get(ref.params)) < 3e-5
+    assert (
+        _max_abs_diff(can.batch_stats, jax.device_get(ref.batch_stats)) < 3e-5
+    )
+
+
+def test_pipe2_zero2_bit_identical_to_off(setup, pipe2):
+    """The ZeRO-2 ladder inside each stage group is a layout, not a math
+    change: same trajectory as pipe=2 off, byte for byte."""
+    model, tx, full, images, labels = setup
+    drv_off, pstate_off, _ = pipe2
+    mesh = make_mesh(ParallelConfig(pipeline_stages=2))
+    drv = make_pipeline_train_step(
+        model, tx, mesh, CompressionConfig(), n_microbatches=M,
+        shard_update="zero2",
+    )
+    pstate = drv.init_state(full)
+    for _ in range(3):
+        pstate, _ = drv.step(pstate, images, labels)
+    can_z, can_o = drv.canonical(pstate), drv_off.canonical(pstate_off)
+    _assert_trees_byte_equal(can_z.params, can_o.params, "params")
+    _assert_trees_byte_equal(can_z.opt_state, can_o.opt_state, "opt_state")
+
+
+def test_pipe2_refuses_space_and_zero3(setup):
+    model, tx, full, _, _ = setup
+    with pytest.raises(ValueError, match="space sharding"):
+        make_pipeline_train_step(
+            model, tx,
+            make_mesh(ParallelConfig(pipeline_stages=2, space_axis_size=2,
+                                     data_axis_size=2)),
+            CompressionConfig(), n_microbatches=M,
+        )
+    with pytest.raises(ValueError, match="zero3"):
+        make_pipeline_train_step(
+            model, tx, make_mesh(ParallelConfig(pipeline_stages=2)),
+            CompressionConfig(), n_microbatches=M, shard_update="zero3",
+        )
+
+
+def test_schedule_occupancy_measured(setup, pipe2):
+    """last_schedule counts the executed round-robin: for S=2 every
+    stage-0 forward, both backwards and the folded loss/backward slot
+    must be dispatched — idle = S(S-1) + (S-1)(S-2) slots of the
+    (stage × cycle) grid, so the measured bubble shrinks with M and
+    sits near the per-phase closed form."""
+    drv, _, _ = pipe2
+    sched = drv.last_schedule
+    S = drv.n_stages
+    # Executed: (S-1)·M forward slots + S·M backward slots.
+    assert sched["executed_slots"] == (2 * S - 1) * M
+    assert sched["idle_slots"] == S * (S - 1) + (S - 1) * (S - 2)
+    assert 0.0 < sched["measured_bubble"] < bubble_fraction(S, M) + 0.1
+    # Shrinks with M: the fraction at 2M microbatches must be smaller.
+    model, tx, full, images, labels = setup
+    drv2 = make_pipeline_train_step(
+        model, tx, make_mesh(ParallelConfig(pipeline_stages=2)),
+        CompressionConfig(), n_microbatches=2 * M,
+    )
+    p = drv2.init_state(full)
+    im2 = np.concatenate([images, images]), np.concatenate([labels, labels])
+    drv2.step(p, im2[0], im2[1])
+    assert drv2.last_schedule["measured_bubble"] < sched["measured_bubble"]
+
+
+def test_step_validates_microbatch_count(setup, pipe2):
+    _, _, _, images, labels = setup[0], setup[1], setup[2], setup[3], setup[4]
+    drv, pstate, _ = pipe2
+    with pytest.raises(ValueError, match="n_microbatches"):
+        drv.step(pstate, images[: M - 1], labels[: M - 1])
+
+
+# -- cross-layout checkpoint matrix (canonical gathered layout) -------------
+
+
+def test_checkpoint_roundtrip_pipe2_zero2(setup):
+    """pipe=2,zero2 ↔ canonical ↔ pipe=1,off: the staged+sharded layout
+    round-trips through the canonical gathered TrainState byte-exactly
+    (placement is lossless), and a canonical snapshot taken mid-run
+    restores into a fresh driver that continues bit-identically."""
+    model, tx, full, images, labels = setup
+    mesh = make_mesh(ParallelConfig(pipeline_stages=2))
+    comp = CompressionConfig()
+    drv = make_pipeline_train_step(
+        model, tx, mesh, comp, n_microbatches=M, shard_update="zero2"
+    )
+    host_full = jax.device_get(full)
+
+    # Placement round-trip, no step: canonical(init_state(x)) == x.
+    can0 = drv.canonical(drv.init_state(full))
+    for field in ("params", "batch_stats", "opt_state"):
+        _assert_trees_byte_equal(
+            getattr(can0, field), getattr(host_full, field), field
+        )
+
+    # Mid-run snapshot: step → canonical → restore into a FRESH pipe2
+    # driver AND into the unstaged pipe=1 path; one more step each must
+    # agree with the uninterrupted staged run.
+    pstate = drv.init_state(full)
+    pstate, _ = drv.step(pstate, images, labels)
+    snap = drv.canonical(pstate)
+    pstate, _ = drv.step(pstate, images, labels)  # uninterrupted arm
+
+    drv2 = make_pipeline_train_step(
+        model, tx, make_mesh(ParallelConfig(pipeline_stages=2)), comp,
+        n_microbatches=M, shard_update="zero2",
+    )
+    restored = drv2.init_state(snap)
+    restored, _ = drv2.step(restored, images, labels)
+    _assert_trees_byte_equal(
+        drv2.canonical(restored).params, drv.canonical(pstate).params,
+        "resumed-pipe2-params",
+    )
+
+    # The same snapshot drives the unstaged builder (pipe=1, off): the
+    # canonical layout is the lingua franca across the matrix.  The two
+    # arms place LOCAL BatchNorm over different per-replica batches
+    # (data=8×1 row vs data=4×2 rows), so trajectories legitimately
+    # differ in the batch statistics — the bound here is one optimizer
+    # step's worth of drift (Adam step size ~lr), which a wrong-layout
+    # restore (garbage params) would blow past by orders of magnitude.
+    drv1 = make_pipeline_train_step(
+        model, tx, make_mesh(ParallelConfig()), comp, n_microbatches=M
+    )
+    p1 = drv1.init_state(snap)
+    p1, m1 = drv1.step(p1, images, labels)
+    assert np.isfinite(m1["loss"])
+    assert int(np.asarray(drv1.canonical(p1).step)) == 2
+    assert (
+        _max_abs_diff(drv1.canonical(p1).params, drv.canonical(pstate).params)
+        < 1e-2
+    )
+
+
+# -- the staged sub-mesh is a first-class (data, space) mesh ----------------
+
+
+def test_stage_submeshes_are_disjoint_data_meshes():
+    mesh = make_mesh(
+        ParallelConfig(pipeline_stages=2, data_axis_size=2, space_axis_size=2)
+    )
+    subs = stage_meshes(mesh)
+    assert len(subs) == 2
+    seen = set()
+    for sub in subs:
+        assert sub.axis_names == ("data", "space")
+        assert sub.shape == {"data": 2, "space": 2}
+        ids = {d.id for d in sub.devices.flat}
+        assert not ids & seen, "stage groups must be disjoint"
+        seen |= ids
